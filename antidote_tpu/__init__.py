@@ -1,0 +1,28 @@
+"""antidote_tpu — a TPU-native transactional CRDT store.
+
+A ground-up JAX/XLA rebuild of the capabilities of AntidoteDB
+(reference: /root/reference, Erlang/riak_core): operation-based CRDTs,
+causal+ snapshot transactions (Cure/ClockSI), per-key op logs, a batched
+device materializer, vector-clock stable-snapshot computation, and
+inter-replica causal replication.
+
+Design stance (not a port):
+  * vector clocks are dense ``i32[MAX_DCS]`` tensors, not dicts
+    (reference: sparse dicts, /root/reference/include/antidote.hrl:187-188)
+  * the per-key op-log fold (``clocksi_materializer:materialize/4``) is a
+    batched masked scan over thousands of keys per device launch
+  * the riak_core ring becomes a ``jax.sharding.Mesh`` over a ``shard`` axis
+  * stable-snapshot = ``min`` collective over per-shard clock matrices
+    (replaces meta_data_sender 1 s gossip rounds)
+"""
+
+import jax as _jax
+
+# The framework stores 64-bit value handles / LWW timestamps in device
+# arrays; without x64 jnp.int64 silently narrows to int32.
+_jax.config.update("jax_enable_x64", True)
+
+from antidote_tpu.config import AntidoteConfig  # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = ["AntidoteConfig", "__version__"]

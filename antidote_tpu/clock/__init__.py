@@ -1,0 +1,23 @@
+from antidote_tpu.clock.vector import (
+    zero,
+    le,
+    lt,
+    eq,
+    concurrent,
+    merge,
+    vmin,
+    increment,
+    dominates_ignoring,
+)
+
+__all__ = [
+    "zero",
+    "le",
+    "lt",
+    "eq",
+    "concurrent",
+    "merge",
+    "vmin",
+    "increment",
+    "dominates_ignoring",
+]

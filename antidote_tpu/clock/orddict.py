@@ -1,0 +1,52 @@
+"""Versioned-snapshot selection — the tensor analogue of ``vector_orddict``.
+
+The reference keeps, per key, an ordered-by-VC list of up to 10 materialized
+snapshots and serves a read from the newest entry whose VC is dominated by
+the read VC (``vector_orddict:get_smaller/2``,
+/root/reference/src/vector_orddict.erl:74-87).  Here each key has a fixed
+ring of ``V`` snapshot versions: ``snap_vc[V, D]`` clocks plus a monotonically
+increasing insertion sequence ``snap_seq[V]`` (0 = empty slot).  Selection is
+a masked argmax over the version axis — one vectorized op per key instead of
+a list walk.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from antidote_tpu.clock import vector as vc
+
+
+def get_smaller(snap_vc, snap_seq, read_vc):
+    """Newest valid snapshot version dominated by ``read_vc``.
+
+    Args:
+      snap_vc:  ``i32[..., V, D]`` per-version clocks.
+      snap_seq: ``i64[..., V]`` insertion sequence numbers; 0 marks an empty
+                slot (matches "ignore" semantics of a missing orddict entry).
+      read_vc:  ``i32[..., D]`` the read snapshot.
+
+    Returns:
+      ``(idx, found)`` — ``idx`` is ``i32[...]`` index into the version axis
+      (0 when nothing matches) and ``found`` is a boolean mask.  A miss means
+      the caller must fall back to folding from the bottom state (the
+      reference falls back to a log replay,
+      /root/reference/src/materializer_vnode.erl:415-419).
+    """
+    dominated = vc.le(snap_vc, read_vc[..., None, :])  # [..., V]
+    valid = snap_seq > 0
+    ok = dominated & valid
+    score = jnp.where(ok, snap_seq, -1)
+    idx = jnp.argmax(score, axis=-1).astype(jnp.int32)
+    found = jnp.max(score, axis=-1) > -1
+    return idx, found
+
+
+def insert_slot(snap_seq):
+    """Slot to overwrite for a new snapshot version: the oldest (min seq).
+
+    Empty slots (seq 0) are naturally preferred.  Mirrors the ≤10-version
+    ring with GC to ?SNAPSHOT_MIN (/root/reference/src/materializer_vnode.erl:513-563),
+    collapsed to a fixed ring: inserting always evicts the oldest version.
+    """
+    return jnp.argmin(snap_seq, axis=-1).astype(jnp.int32)

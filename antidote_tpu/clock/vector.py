@@ -1,0 +1,72 @@
+"""Vector clocks as dense integer tensors.
+
+The reference represents vector clocks as sparse dicts keyed by dcid (the
+``vectorclock`` dep; see /root/reference/include/antidote.hrl:187-188) and
+compares them entry-wise (e.g. ``vectorclock:le`` used throughout
+clocksi_materializer).  Here a VC is an ``i32[max_dcs]`` row — logical
+per-DC commit counters — and every comparison is a vectorized lane op, so a
+batch of VC comparisons is one fused XLA op rather than a dict fold per op
+(/root/reference/src/clocksi_materializer.erl:214-268).
+
+All functions broadcast: inputs may be ``[..., D]`` stacks of clocks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CLOCK_DTYPE = jnp.int32
+
+
+def zero(max_dcs: int):
+    """The bottom clock (vectorclock:new())."""
+    return jnp.zeros((max_dcs,), dtype=CLOCK_DTYPE)
+
+
+def le(a, b):
+    """a ≤ b in the partial order (all entries ≤). vectorclock:le/2."""
+    return jnp.all(a <= b, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def lt(a, b):
+    """a ≤ b and a ≠ b (strict dominance)."""
+    return le(a, b) & ~eq(a, b)
+
+
+def concurrent(a, b):
+    """Neither dominates (vector_orddict concurrency test,
+    /root/reference/src/vector_orddict.erl:148-151)."""
+    return ~le(a, b) & ~le(b, a)
+
+
+def merge(a, b):
+    """Entry-wise max (vectorclock:max)."""
+    return jnp.maximum(a, b)
+
+
+def vmin(a, b):
+    """Entry-wise min (vectorclock:min) — the stable-snapshot merge
+    (/root/reference/src/stable_time_functions.erl:51-85)."""
+    return jnp.minimum(a, b)
+
+
+def increment(vc, dc_index):
+    """Bump one DC's entry by 1 (commit-counter advance)."""
+    return vc.at[..., dc_index].add(1)
+
+
+def dominates_ignoring(a, b, ignore_dc):
+    """a ≥ b on every lane except ``ignore_dc``.
+
+    Used by the inter-DC causal gate: a remote txn is applied once the local
+    partition VC dominates the txn's snapshot VC with the origin entry
+    zeroed (/root/reference/src/inter_dc_dep_vnode.erl:128-154).
+    """
+    d = a.shape[-1]
+    lane_ok = a >= b
+    ignore = jnp.arange(d) == ignore_dc
+    return jnp.all(lane_ok | ignore, axis=-1)

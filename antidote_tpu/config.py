@@ -1,0 +1,67 @@
+"""Global configuration for an antidote_tpu deployment.
+
+Mirrors the reference's compile-time knobs (/root/reference/include/antidote.hrl:10-79)
+and app-env flags (/root/reference/src/antidote.app.src:29-62), re-expressed for a
+fixed-shape tensor store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class AntidoteConfig:
+    """Deployment-wide sizing and semantics knobs.
+
+    The reference sizes (16-partition ring, 20 read servers, GC thresholds
+    10/3/50/5 — include/antidote.hrl:28,36-47) inform the defaults, but
+    here shapes must be static for XLA so they are explicit.
+    """
+
+    # --- cluster shape -------------------------------------------------
+    #: number of shards ("partitions"); reference default ring size = 16
+    #: (/root/reference/config/vars.config:5)
+    n_shards: int = 8
+    #: dense vector-clock width: max number of DCs (replicas). Reference VCs
+    #: are dicts keyed by dcid; we use a stable dcid->lane registry.
+    max_dcs: int = 4
+
+    # --- per-type table sizing ----------------------------------------
+    #: op-ring slots per key before a GC fold is forced. Analogue of
+    #: ?OPS_THRESHOLD=50 (include/antidote.hrl:44) — ours is a hard ring size.
+    ops_per_key: int = 16
+    #: materialized snapshot versions retained per key. Analogue of
+    #: ?SNAPSHOT_THRESHOLD=10 / ?SNAPSHOT_MIN=3 (include/antidote.hrl:36-41).
+    snap_versions: int = 2
+    #: element slots per set/map key (set_aw/set_rw/set_go/map membership)
+    set_slots: int = 16
+    #: concurrent-value slots for register_mv
+    mv_slots: int = 4
+    #: element slots per rga sequence key
+    rga_slots: int = 64
+    #: number of key slots per (shard, type) table; grows by doubling
+    keys_per_table: int = 1024
+
+    # --- read batching -------------------------------------------------
+    #: read/commit batches are padded up to one of these sizes to bound
+    #: the number of compiled kernel variants
+    batch_buckets: tuple = (64, 512, 4096)
+
+    # --- durability (reference: antidote.app.src:44-48) ---------------
+    enable_logging: bool = True
+    sync_log: bool = False
+
+    # --- misc ----------------------------------------------------------
+    #: store a fresh snapshot version only if at least this many ops were
+    #: folded (?MIN_OP_STORE_SS=5, include/antidote.hrl:47)
+    min_op_store_ss: int = 5
+
+    def __post_init__(self):
+        assert self.n_shards >= 1
+        assert self.max_dcs >= 1
+        assert self.snap_versions >= 1
+        assert self.ops_per_key >= 2
+
+
+DEFAULT_CONFIG = AntidoteConfig()

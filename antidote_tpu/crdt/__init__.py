@@ -1,0 +1,63 @@
+"""CRDT type registry.
+
+The 12-type capability surface counted in SURVEY §2.8 plus rga
+(BASELINE.json).  ``is_type``/``get_type`` mirror ``antidote_crdt:is_type``
+(/root/reference/src/antidote.erl:184).  Maps (map_rr/map_go) are host-level
+composites over these device types and register themselves on import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from antidote_tpu.crdt.base import CRDTType
+from antidote_tpu.crdt.blob import BlobStore
+from antidote_tpu.crdt.counters import CounterB, CounterFat, CounterPN
+from antidote_tpu.crdt.flags import FlagDW, FlagEW
+from antidote_tpu.crdt.registers import RegisterLWW, RegisterMV
+from antidote_tpu.crdt.sets import SetAW, SetGO, SetRW
+
+TYPES: Dict[str, CRDTType] = {}
+TYPES_BY_ID: Dict[int, CRDTType] = {}
+
+
+def register_type(t: CRDTType) -> CRDTType:
+    assert t.name not in TYPES, t.name
+    assert t.type_id not in TYPES_BY_ID, t.type_id
+    TYPES[t.name] = t
+    TYPES_BY_ID[t.type_id] = t
+    return t
+
+
+for _t in (
+    CounterPN(),
+    CounterFat(),
+    CounterB(),
+    RegisterLWW(),
+    RegisterMV(),
+    SetAW(),
+    SetRW(),
+    SetGO(),
+    FlagEW(),
+    FlagDW(),
+):
+    register_type(_t)
+
+
+def is_type(name: str) -> bool:
+    return name in TYPES
+
+
+def get_type(name: str) -> CRDTType:
+    return TYPES[name]
+
+
+__all__ = [
+    "TYPES",
+    "TYPES_BY_ID",
+    "register_type",
+    "is_type",
+    "get_type",
+    "BlobStore",
+    "CRDTType",
+]

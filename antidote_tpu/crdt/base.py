@@ -1,0 +1,117 @@
+"""The CRDT type behaviour — the plugin boundary of the framework.
+
+Reproduces the ``antidote_crdt`` behaviour visible at the reference's call
+sites (SURVEY §2.8; /root/reference/src/materializer.erl:45-58,
+/root/reference/src/clocksi_downstream.erl:38-68,
+/root/reference/src/antidote.erl:183-200), re-shaped for a tensor store:
+
+  * per-key state is a dict of fixed-shape arrays (``state_spec``)
+  * a *downstream effect* is a pair of fixed-width lanes
+    ``(eff_a: i64[A], eff_b: i32[B])`` produced on host from the client op
+    (and, for observed-remove semantics, the current state snapshot)
+  * ``apply`` is a pure JAX function folding one effect into one key's
+    state; the materializer vmaps/scans it across keys and op rings
+  * ``value`` decodes a host copy of the state into the client-visible value
+
+Effects, not ops, are what the log stores and replication ships — exactly
+the reference's op-based CRDT model (Type:downstream then Type:update).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt.blob import BlobStore
+
+# One downstream effect, host-side: (eff_a int64 lanes, eff_b int32 lanes,
+# list of (handle, payload-bytes) the effect references).
+Effect = Tuple[np.ndarray, np.ndarray, List[Tuple[int, bytes]]]
+
+
+class CRDTType(abc.ABC):
+    """Behaviour implemented by every CRDT type."""
+
+    #: wire/type-registry name, e.g. "counter_pn"
+    name: str
+    #: stable small integer id (used in logs and wire format)
+    type_id: int
+
+    # ---- host side ----------------------------------------------------
+
+    def eff_a_width(self, cfg: AntidoteConfig) -> int:
+        """i64 lanes per effect."""
+        return 1
+
+    def eff_b_width(self, cfg: AntidoteConfig) -> int:
+        """i32 lanes per effect (may depend on max_dcs)."""
+        return 1
+
+    @abc.abstractmethod
+    def state_spec(self, cfg: AntidoteConfig) -> Dict[str, Tuple[tuple, Any]]:
+        """name -> (per-key shape suffix, dtype) of the device state arrays."""
+
+    @abc.abstractmethod
+    def is_operation(self, op: Tuple[str, Any]) -> bool:
+        """Type-check a client update (antidote:type_check/1,
+        /root/reference/src/antidote.erl:183-200)."""
+
+    def require_state_downstream(self, op: Tuple[str, Any]) -> bool:
+        """Whether downstream generation needs the current snapshot
+        (Type:require_state_downstream/1,
+        /root/reference/src/clocksi_downstream.erl:43)."""
+        return False
+
+    @abc.abstractmethod
+    def downstream(
+        self,
+        op: Tuple[str, Any],
+        state: Dict[str, np.ndarray] | None,
+        blobs: BlobStore,
+        cfg: AntidoteConfig,
+    ) -> List[Effect]:
+        """Turn a client op into downstream effect(s).
+
+        ``state`` is a host copy of the key's *materialized* per-key state
+        (present iff require_state_downstream), used for observed-remove
+        semantics.  May return several effects (e.g. add_all).
+        """
+
+    @abc.abstractmethod
+    def value(
+        self, state: Dict[str, np.ndarray], blobs: BlobStore, cfg: AntidoteConfig
+    ) -> Any:
+        """Client-visible value of a host state copy (Type:value/1)."""
+
+    # ---- device side ---------------------------------------------------
+
+    @abc.abstractmethod
+    def apply(
+        self,
+        cfg: AntidoteConfig,
+        state: Dict[str, Any],
+        eff_a,
+        eff_b,
+        commit_vc,
+        origin_dc,
+    ) -> Dict[str, Any]:
+        """Fold one effect into one key's state.  Pure JAX; traced inside the
+        materializer scan (Type:update/2,
+        /root/reference/src/materializer.erl:51-58)."""
+
+
+def pack_a(*vals: int, width: int) -> np.ndarray:
+    out = np.zeros((width,), dtype=np.int64)
+    for i, v in enumerate(vals):
+        out[i] = v
+    return out
+
+
+def pack_b(vals: Sequence[int], width: int) -> np.ndarray:
+    out = np.zeros((width,), dtype=np.int32)
+    for i, v in enumerate(vals):
+        out[i] = v
+    return out

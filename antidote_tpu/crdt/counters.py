@@ -1,0 +1,186 @@
+"""Counter CRDTs: counter_pn, counter_fat, counter_b.
+
+Semantics follow the antidote_crdt library types referenced throughout the
+reference source (SURVEY §2.8): ``antidote_crdt_counter_pn`` (plain PN
+counter), ``antidote_crdt_counter_fat`` (PN counter with reset; reference
+keeps {token, amount} pairs, we keep per-DC lanes with reset epochs), and
+``antidote_crdt_counter_b`` (bounded/escrow counter; rights matrix R and
+used vector U per Balegas et al., managed by bcounter_mgr —
+/root/reference/src/bcounter_mgr.erl:80-146).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.crdt.base import CRDTType, Effect, pack_a, pack_b
+
+
+class CounterPN(CRDTType):
+    """Positive-negative counter: state = one i64; effect = signed delta.
+
+    The fold is a masked sum — fully associative, so large op rings could be
+    folded with an associative scan (SURVEY §2.10 last row).
+    """
+
+    name = "counter_pn"
+    type_id = 1
+
+    def state_spec(self, cfg):
+        return {"cnt": ((), jnp.int64)}
+
+    def is_operation(self, op):
+        kind, arg = op
+        return kind in ("increment", "decrement") and isinstance(arg, int)
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        kind, n = op
+        delta = n if kind == "increment" else -n
+        return [(pack_a(delta, width=1), pack_b([], width=self.eff_b_width(cfg)), [])]
+
+    def value(self, state, blobs, cfg):
+        return int(state["cnt"])
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        return {"cnt": state["cnt"] + eff_a[0]}
+
+
+class CounterFat(CRDTType):
+    """PN counter with reset ("fat" counter).
+
+    Reference state is an orddict of {unique_token -> amount}; ``reset``
+    removes exactly the observed tokens, so concurrent increments survive
+    (antidote_crdt_counter_fat).  Dense analogue: one accumulator lane per
+    DC plus a per-lane epoch.  ``increment`` adds to the origin lane;
+    ``reset`` subtracts the *observed* per-lane amounts and bumps the lane
+    epoch, so a second reset that observed the same epoch is a no-op on that
+    lane.  Increments concurrent with a reset land on top of the observed
+    amount and therefore survive, matching token semantics.
+
+    Effect lanes: eff_a = [inc_delta, observed_amt[0..D)];
+    eff_b = [kind(0=inc,1=reset), observed_epoch[0..D)].
+    """
+
+    name = "counter_fat"
+    type_id = 2
+
+    def eff_a_width(self, cfg):
+        return 1 + cfg.max_dcs
+
+    def eff_b_width(self, cfg):
+        return 1 + cfg.max_dcs
+
+    def state_spec(self, cfg):
+        d = cfg.max_dcs
+        return {"amt": ((d,), jnp.int64), "epoch": ((d,), jnp.int32)}
+
+    def is_operation(self, op):
+        kind, arg = op
+        if kind in ("increment", "decrement"):
+            return isinstance(arg, int)
+        return kind == "reset"
+
+    def require_state_downstream(self, op):
+        return op[0] == "reset"
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        d = cfg.max_dcs
+        aw, bw = self.eff_a_width(cfg), self.eff_b_width(cfg)
+        kind, arg = op
+        a = np.zeros((aw,), dtype=np.int64)
+        b = np.zeros((bw,), dtype=np.int32)
+        if kind in ("increment", "decrement"):
+            a[0] = arg if kind == "increment" else -arg
+            return [(a, b, [])]
+        a[1 : 1 + d] = np.asarray(state["amt"], dtype=np.int64)
+        b[0] = 1
+        b[1 : 1 + d] = np.asarray(state["epoch"], dtype=np.int32)
+        return [(a, b, [])]
+
+    def value(self, state, blobs, cfg):
+        return int(np.sum(np.asarray(state["amt"])))
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        d = cfg.max_dcs
+        amt, epoch = state["amt"], state["epoch"]
+        is_reset = eff_b[0] == 1
+        inc_amt = amt.at[origin_dc].add(eff_a[0])
+        obs_amt = eff_a[1 : 1 + d]
+        obs_ep = eff_b[1 : 1 + d]
+        lane_live = epoch == obs_ep
+        reset_amt = jnp.where(lane_live, amt - obs_amt, amt)
+        reset_ep = jnp.where(lane_live, epoch + 1, epoch)
+        new_amt = jnp.where(is_reset, reset_amt, inc_amt)
+        new_ep = jnp.where(is_reset, reset_ep, epoch)
+        return {"amt": new_amt, "epoch": new_ep}
+
+
+class CounterB(CRDTType):
+    """Bounded (escrow) counter.
+
+    State: rights matrix ``R[i, j]`` = rights minted at i (diagonal) or
+    transferred from lane i to lane j, and ``U[i]`` = rights consumed by
+    decrements at i.  value = Σ_i R[i,i] − Σ_i U[i]; rights locally held by
+    lane i = R[i,i] + Σ_{j≠i} R[j,i] − Σ_{j≠i} R[i,j] − U[i].  Decrement
+    safety (never below zero) is enforced by the bcounter manager in the
+    txn layer, mirroring /root/reference/src/bcounter_mgr.erl:80-97.
+
+    Ops: ("increment", (n, dc)), ("decrement", (n, dc)),
+    ("transfer", (n, to_dc, from_dc)).
+    Effect lanes: eff_a = [n]; eff_b = [kind(0=inc,1=dec,2=xfer), src, dst].
+    """
+
+    name = "counter_b"
+    type_id = 3
+
+    def eff_b_width(self, cfg):
+        return 3
+
+    def state_spec(self, cfg):
+        d = cfg.max_dcs
+        return {"rights": ((d, d), jnp.int64), "used": ((d,), jnp.int64)}
+
+    def is_operation(self, op):
+        kind, arg = op
+        if kind in ("increment", "decrement"):
+            return isinstance(arg, tuple) and len(arg) == 2
+        return kind == "transfer" and isinstance(arg, tuple) and len(arg) == 3
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        bw = self.eff_b_width(cfg)
+        kind, arg = op
+        if kind == "increment":
+            n, dc = arg
+            return [(pack_a(n, width=1), pack_b([0, dc, dc], width=bw), [])]
+        if kind == "decrement":
+            n, dc = arg
+            return [(pack_a(n, width=1), pack_b([1, dc, dc], width=bw), [])]
+        n, to_dc, from_dc = arg
+        return [(pack_a(n, width=1), pack_b([2, from_dc, to_dc], width=bw), [])]
+
+    def value(self, state, blobs, cfg):
+        r = np.asarray(state["rights"])
+        u = np.asarray(state["used"])
+        return int(np.trace(r) - np.sum(u))
+
+    def local_rights(self, state, dc: int) -> int:
+        """Rights currently held by lane ``dc`` (bcounter_mgr:localPermissions,
+        /root/reference/src/bcounter_mgr.erl:122-124)."""
+        r = np.asarray(state["rights"])
+        u = np.asarray(state["used"])
+        incoming = r[:, dc].sum() - r[dc, dc]
+        outgoing = r[dc, :].sum() - r[dc, dc]
+        return int(r[dc, dc] + incoming - outgoing - u[dc])
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        rights, used = state["rights"], state["used"]
+        n = eff_a[0]
+        kind, src, dst = eff_b[0], eff_b[1], eff_b[2]
+        inc_r = rights.at[src, src].add(n)
+        xfer_r = rights.at[src, dst].add(n)
+        new_rights = jnp.where(kind == 0, inc_r, jnp.where(kind == 2, xfer_r, rights))
+        new_used = jnp.where(kind == 1, used.at[src].add(n), used)
+        return {"rights": new_rights, "used": new_used}

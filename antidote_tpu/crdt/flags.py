@@ -1,0 +1,101 @@
+"""Flag CRDTs: flag_ew (enable-wins) and flag_dw (disable-wins).
+
+Same dot pattern as the sets, over a single implicit element:
+
+  * flag_ew: enabled ⟺ ∃dc: en_vc[dc] > dis_vc[dc].  A disable observes the
+    current enable dots and covers them; a concurrent enable survives.
+  * flag_dw: enabled ⟺ enables exist ∧ en_vc ≥ dis_vc pointwise.  An enable
+    covers observed disables; a concurrent disable wins.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.crdt.base import CRDTType, Effect
+
+_ENABLE, _DISABLE, _RESET = 0, 1, 2
+
+
+class _FlagBase(CRDTType):
+    def eff_b_width(self, cfg):
+        return 1 + cfg.max_dcs
+
+    def state_spec(self, cfg):
+        d = cfg.max_dcs
+        return {"envc": ((d,), jnp.int32), "disvc": ((d,), jnp.int32)}
+
+    def is_operation(self, op):
+        return op[0] in ("enable", "disable", "reset")
+
+    def _effect(self, kind: int, observed, cfg) -> Effect:
+        d = cfg.max_dcs
+        b = np.zeros((self.eff_b_width(cfg),), dtype=np.int32)
+        b[0] = kind
+        if observed is not None:
+            b[1 : 1 + d] = np.asarray(observed, dtype=np.int32)
+        return (np.zeros((1,), dtype=np.int64), b, [])
+
+
+class FlagEW(_FlagBase):
+    name = "flag_ew"
+    type_id = 9
+
+    def require_state_downstream(self, op):
+        return op[0] in ("disable", "reset")
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        kind = op[0]
+        if kind == "enable":
+            return [self._effect(_ENABLE, None, cfg)]
+        # disable and reset both cover the observed enables
+        return [self._effect(_DISABLE, state["envc"], cfg)]
+
+    def value(self, state, blobs, cfg):
+        return bool(np.any(np.asarray(state["envc"]) > np.asarray(state["disvc"])))
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        d = cfg.max_dcs
+        envc, disvc = state["envc"], state["disvc"]
+        kind = eff_b[0]
+        obs = eff_b[1 : 1 + d]
+        en_new = envc.at[origin_dc].max(commit_vc[origin_dc])
+        dis_new = jnp.maximum(disvc, obs)
+        return {
+            "envc": jnp.where(kind == _ENABLE, en_new, envc),
+            "disvc": jnp.where(kind == _ENABLE, disvc, dis_new),
+        }
+
+
+class FlagDW(_FlagBase):
+    name = "flag_dw"
+    type_id = 10
+
+    def require_state_downstream(self, op):
+        return op[0] == "enable"
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        kind = op[0]
+        if kind == "enable":
+            return [self._effect(_ENABLE, state["disvc"], cfg)]
+        return [self._effect(_DISABLE, None, cfg)]
+
+    def value(self, state, blobs, cfg):
+        envc = np.asarray(state["envc"])
+        disvc = np.asarray(state["disvc"])
+        return bool(np.any(envc > 0) and np.all(envc >= disvc))
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        d = cfg.max_dcs
+        envc, disvc = state["envc"], state["disvc"]
+        kind = eff_b[0]
+        obs = eff_b[1 : 1 + d]
+        en_new = jnp.maximum(envc, obs).at[origin_dc].max(commit_vc[origin_dc])
+        dis_new = disvc.at[origin_dc].max(commit_vc[origin_dc])
+        return {
+            "envc": jnp.where(kind == _ENABLE, en_new, envc),
+            "disvc": jnp.where(kind == _ENABLE, disvc, dis_new),
+        }

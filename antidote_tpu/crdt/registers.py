@@ -1,0 +1,131 @@
+"""Register CRDTs: register_lww and register_mv.
+
+Mirrors ``antidote_crdt_register_lww`` (last-writer-wins on a wall-clock
+timestamp carried in the downstream effect) and
+``antidote_crdt_register_mv`` (multi-value: an assign overwrites exactly
+the entries observed at downstream time; concurrent assigns coexist).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.crdt.base import CRDTType, Effect, pack_a, pack_b
+from antidote_tpu.crdt.blob import EMPTY_HANDLE
+
+
+def _now_micros() -> int:
+    return time.time_ns() // 1000
+
+
+class RegisterLWW(CRDTType):
+    """state = (value handle, timestamp); effect = (handle, ts).
+
+    Ties on ts break on the handle so replicas converge deterministically
+    (the reference compares {Ts, Value} pairs).
+    """
+
+    name = "register_lww"
+    type_id = 4
+
+    def eff_a_width(self, cfg):
+        return 2  # handle, ts
+
+    def state_spec(self, cfg):
+        return {"val": ((), jnp.int64), "ts": ((), jnp.int64)}
+
+    def is_operation(self, op):
+        return op[0] == "assign"
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        _, value = op
+        h = blobs.intern(value)
+        return [
+            (
+                pack_a(h, _now_micros(), width=2),
+                pack_b([], width=self.eff_b_width(cfg)),
+                [(h, blobs.bytes_of(h))],
+            )
+        ]
+
+    def value(self, state, blobs, cfg):
+        return blobs.resolve(int(state["val"]))
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        h, ts = eff_a[0], eff_a[1]
+        newer = (ts > state["ts"]) | ((ts == state["ts"]) & (h > state["val"]))
+        return {
+            "val": jnp.where(newer, h, state["val"]),
+            "ts": jnp.where(newer, ts, state["ts"]),
+        }
+
+
+class RegisterMV(CRDTType):
+    """Multi-value register.
+
+    Each live entry has a unique id = (origin_dc, commit counter at origin)
+    packed into an i64.  An assign's downstream captures the ids observed at
+    generation time; apply removes exactly those entries and inserts the new
+    one.  Two concurrent assigns don't observe each other, so both survive —
+    the reference's token-based observed-overwrite semantics without any VC
+    comparison in the fold.
+
+    Effect lanes: eff_a = [handle, obs_id[0..mv_slots)].
+    """
+
+    name = "register_mv"
+    type_id = 5
+
+    def eff_a_width(self, cfg):
+        return 1 + cfg.mv_slots
+
+    def state_spec(self, cfg):
+        k = cfg.mv_slots
+        return {"vals": ((k,), jnp.int64), "ids": ((k,), jnp.int64)}
+
+    def is_operation(self, op):
+        return op[0] == "assign"
+
+    def require_state_downstream(self, op):
+        return True
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        _, value = op
+        h = blobs.intern(value)
+        aw = self.eff_a_width(cfg)
+        a = np.zeros((aw,), dtype=np.int64)
+        a[0] = h
+        obs = np.asarray(state["ids"], dtype=np.int64)
+        a[1 : 1 + obs.shape[0]] = obs
+        return [(a, pack_b([], width=self.eff_b_width(cfg)), [(h, blobs.bytes_of(h))])]
+
+    def value(self, state, blobs, cfg):
+        vals = np.asarray(state["vals"])
+        ids = np.asarray(state["ids"])
+        out = [blobs.resolve(int(v)) for v, i in zip(vals, ids) if i != 0]
+        return sorted(out, key=repr)
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        k = cfg.mv_slots
+        vals, ids = state["vals"], state["ids"]
+        h = eff_a[0]
+        obs = eff_a[1 : 1 + k]
+        new_id = (
+            commit_vc[origin_dc].astype(jnp.int64) << 8
+        ) | origin_dc.astype(jnp.int64)
+        # drop observed entries
+        observed = jnp.any(ids[:, None] == obs[None, :], axis=1) & (ids != 0)
+        ids1 = jnp.where(observed, 0, ids)
+        vals1 = jnp.where(observed, EMPTY_HANDLE, vals)
+        # insert the new entry into a free slot (dedupe: same id can't occur
+        # twice since commit counters are unique per origin)
+        free = ids1 == 0
+        slot = jnp.argmax(free)
+        has_free = jnp.any(free)
+        ids2 = jnp.where(has_free, ids1.at[slot].set(new_id), ids1)
+        vals2 = jnp.where(has_free, vals1.at[slot].set(h), vals1)
+        return {"vals": vals2, "ids": ids2}

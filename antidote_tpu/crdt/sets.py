@@ -1,0 +1,269 @@
+"""Set CRDTs: set_aw (add-wins / OR-set), set_rw (remove-wins), set_go.
+
+Dense layouts for the antidote_crdt set types (SURVEY §2.8).  Each key has
+``E = cfg.set_slots`` element slots; a slot holds the element's blob handle
+plus two per-DC clock rows whose comparison decides presence:
+
+  * set_aw: present ⟺ ∃dc: add_vc[dc] > rm_vc[dc] — the optimized OR-set
+    (per-element add dots vs observed-remove dots).  A remove's downstream
+    observes the current add_vc (require_state_downstream, reference
+    /root/reference/src/clocksi_downstream.erl:43), so concurrent adds —
+    whose dot the remove could not have observed — survive.
+  * set_rw: present ⟺ element exists ∧ add_vc ≥ rm_vc pointwise; an add's
+    downstream observes current rm_vc and covers it, so causally-past
+    removes are overridden but concurrent removes win.
+  * set_go: grow-only: a slot, once taken, never clears.
+
+Because effects are applied in causal order (the dep gate,
+/root/reference/src/inter_dc_dep_vnode.erl:128-154), an absent aw-element's
+slot can be reclaimed: any later add is either causally after the remove
+(fresh dot ⇒ present) or concurrent (unobserved dot ⇒ present) — no
+tombstone needed.  rw-set slots are only reclaimed when fully empty, since
+a remove must out-survive concurrent adds.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.crdt.base import CRDTType, Effect, pack_b
+from antidote_tpu.crdt.blob import EMPTY_HANDLE
+
+
+def _elem_effects(op, blobs, make):
+    kind, arg = op
+    if kind.endswith("_all"):
+        return [make(v) for v in arg]
+    return [make(arg)]
+
+
+class SetAW(CRDTType):
+    """Add-wins OR-set.
+
+    Effect lanes: eff_a = [handle]; eff_b = [kind(0=add,1=rm),
+    observed_add_vc[0..D)] (observed row zero for adds).
+    """
+
+    name = "set_aw"
+    type_id = 6
+
+    def eff_b_width(self, cfg):
+        return 1 + cfg.max_dcs
+
+    def state_spec(self, cfg):
+        e, d = cfg.set_slots, cfg.max_dcs
+        return {
+            "elems": ((e,), jnp.int64),
+            "addvc": ((e, d), jnp.int32),
+            "rmvc": ((e, d), jnp.int32),
+        }
+
+    def is_operation(self, op):
+        return op[0] in ("add", "remove", "add_all", "remove_all")
+
+    def require_state_downstream(self, op):
+        return op[0] in ("remove", "remove_all", "reset")
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        d = cfg.max_dcs
+        bw = self.eff_b_width(cfg)
+        kind = op[0]
+
+        def make(value):
+            h = blobs.intern(value)
+            a = np.asarray([h], dtype=np.int64)
+            b = np.zeros((bw,), dtype=np.int32)
+            if kind.startswith("remove"):
+                b[0] = 1
+                elems = np.asarray(state["elems"])
+                hit = np.nonzero(elems == h)[0]
+                if hit.size:
+                    b[1 : 1 + d] = np.asarray(state["addvc"])[hit[0]]
+            return (a, b, [(h, blobs.bytes_of(h))])
+
+        return _elem_effects(op, blobs, make)
+
+    def value(self, state, blobs, cfg):
+        elems = np.asarray(state["elems"])
+        present = np.any(
+            np.asarray(state["addvc"]) > np.asarray(state["rmvc"]), axis=-1
+        ) & (elems != EMPTY_HANDLE)
+        return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        d = cfg.max_dcs
+        elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
+        h = eff_a[0]
+        is_rm = eff_b[0] == 1
+        obs = eff_b[1 : 1 + d]
+
+        match = (elems == h) & (elems != EMPTY_HANDLE)
+        has_match = jnp.any(match)
+        idx_match = jnp.argmax(match)
+
+        present = jnp.any(addvc > rmvc, axis=-1) & (elems != EMPTY_HANDLE)
+        free = ~present
+        idx_free = jnp.argmax(free)
+        has_free = jnp.any(free)
+
+        # --- add path: take matching slot, else a free slot (reset its rows)
+        idx_add = jnp.where(has_match, idx_match, idx_free)
+        fresh = ~has_match
+        add_row_add = jnp.where(fresh, jnp.zeros((d,), jnp.int32), addvc[idx_add])
+        add_row_rm = jnp.where(fresh, jnp.zeros((d,), jnp.int32), rmvc[idx_add])
+        add_row_add = add_row_add.at[origin_dc].max(commit_vc[origin_dc])
+        can_add = has_match | has_free
+        elems_a = jnp.where(can_add, elems.at[idx_add].set(h), elems)
+        addvc_a = jnp.where(can_add, addvc.at[idx_add].set(add_row_add), addvc)
+        rmvc_a = jnp.where(can_add, rmvc.at[idx_add].set(add_row_rm), rmvc)
+
+        # --- remove path: raise rm_vc to the observed add dots
+        rm_row = jnp.maximum(rmvc[idx_match], obs)
+        rmvc_r = jnp.where(has_match, rmvc.at[idx_match].set(rm_row), rmvc)
+
+        return {
+            "elems": jnp.where(is_rm, elems, elems_a),
+            "addvc": jnp.where(is_rm, addvc, addvc_a),
+            "rmvc": jnp.where(is_rm, rmvc_r, rmvc_a),
+        }
+
+
+class SetRW(CRDTType):
+    """Remove-wins set.
+
+    Effect lanes: eff_a = [handle]; eff_b = [kind(0=add,1=rm),
+    observed_rm_vc[0..D)] (observed row zero for removes).
+    """
+
+    name = "set_rw"
+    type_id = 7
+
+    def eff_b_width(self, cfg):
+        return 1 + cfg.max_dcs
+
+    def state_spec(self, cfg):
+        e, d = cfg.set_slots, cfg.max_dcs
+        return {
+            "elems": ((e,), jnp.int64),
+            "addvc": ((e, d), jnp.int32),
+            "rmvc": ((e, d), jnp.int32),
+        }
+
+    def is_operation(self, op):
+        return op[0] in ("add", "remove", "add_all", "remove_all")
+
+    def require_state_downstream(self, op):
+        return op[0] in ("add", "add_all")
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        d = cfg.max_dcs
+        bw = self.eff_b_width(cfg)
+        kind = op[0]
+
+        def make(value):
+            h = blobs.intern(value)
+            a = np.asarray([h], dtype=np.int64)
+            b = np.zeros((bw,), dtype=np.int32)
+            if kind.startswith("remove"):
+                b[0] = 1
+            else:
+                elems = np.asarray(state["elems"])
+                hit = np.nonzero(elems == h)[0]
+                if hit.size:
+                    b[1 : 1 + d] = np.asarray(state["rmvc"])[hit[0]]
+            return (a, b, [(h, blobs.bytes_of(h))])
+
+        return _elem_effects(op, blobs, make)
+
+    def _present(self, elems, addvc, rmvc):
+        has_add = np.any(np.asarray(addvc) > 0, axis=-1)
+        covered = np.all(np.asarray(addvc) >= np.asarray(rmvc), axis=-1)
+        return (np.asarray(elems) != EMPTY_HANDLE) & has_add & covered
+
+    def value(self, state, blobs, cfg):
+        elems = np.asarray(state["elems"])
+        present = self._present(elems, state["addvc"], state["rmvc"])
+        return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        d = cfg.max_dcs
+        elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
+        h = eff_a[0]
+        is_rm = eff_b[0] == 1
+        obs_rm = eff_b[1 : 1 + d]
+
+        match = (elems == h) & (elems != EMPTY_HANDLE)
+        has_match = jnp.any(match)
+        idx_match = jnp.argmax(match)
+        free = elems == EMPTY_HANDLE
+        idx_free = jnp.argmax(free)
+        has_free = jnp.any(free)
+
+        # --- add: cover observed removes, stamp own dot
+        idx_add = jnp.where(has_match, idx_match, idx_free)
+        row_add = jnp.where(has_match, addvc[idx_add], jnp.zeros((d,), jnp.int32))
+        row_add = jnp.maximum(row_add, obs_rm).at[origin_dc].max(commit_vc[origin_dc])
+        can_add = has_match | has_free
+        elems_a = jnp.where(can_add, elems.at[idx_add].set(h), elems)
+        addvc_a = jnp.where(can_add, addvc.at[idx_add].set(row_add), addvc)
+
+        # --- remove: stamp own dot on the rm row (create slot if needed so
+        # the remove out-survives concurrent adds)
+        idx_rm = jnp.where(has_match, idx_match, idx_free)
+        can_rm = has_match | has_free
+        row_rm_base = jnp.where(has_match, rmvc[idx_rm], jnp.zeros((d,), jnp.int32))
+        row_rm = row_rm_base.at[origin_dc].max(commit_vc[origin_dc])
+        elems_r = jnp.where(can_rm, elems.at[idx_rm].set(h), elems)
+        rmvc_r = jnp.where(can_rm, rmvc.at[idx_rm].set(row_rm), rmvc)
+
+        return {
+            "elems": jnp.where(is_rm, elems_r, elems_a),
+            "addvc": jnp.where(is_rm, addvc, addvc_a),
+            "rmvc": jnp.where(is_rm, rmvc_r, rmvc),
+        }
+
+
+class SetGO(CRDTType):
+    """Grow-only set: slots fill monotonically."""
+
+    name = "set_go"
+    type_id = 8
+
+    def state_spec(self, cfg):
+        e = cfg.set_slots
+        return {"elems": ((e,), jnp.int64)}
+
+    def is_operation(self, op):
+        return op[0] in ("add", "add_all")
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        bw = self.eff_b_width(cfg)
+
+        def make(value):
+            h = blobs.intern(value)
+            return (
+                np.asarray([h], dtype=np.int64),
+                np.zeros((bw,), dtype=np.int32),
+                [(h, blobs.bytes_of(h))],
+            )
+
+        return _elem_effects(op, blobs, make)
+
+    def value(self, state, blobs, cfg):
+        elems = np.asarray(state["elems"])
+        return sorted(
+            (blobs.resolve(int(h)) for h in elems[elems != EMPTY_HANDLE]), key=repr
+        )
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        elems = state["elems"]
+        h = eff_a[0]
+        match = elems == h
+        has_match = jnp.any(match)
+        free = elems == EMPTY_HANDLE
+        idx = jnp.argmax(free)
+        do_insert = ~has_match & jnp.any(free)
+        return {"elems": jnp.where(do_insert, elems.at[idx].set(h), elems)}

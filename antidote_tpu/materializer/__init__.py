@@ -1,0 +1,3 @@
+from antidote_tpu.materializer.fold import fold_batch, fold_key, eager_fold_batch
+
+__all__ = ["fold_batch", "fold_key", "eager_fold_batch"]

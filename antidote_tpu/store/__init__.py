@@ -1,0 +1,3 @@
+from antidote_tpu.store.typed_table import TypedTable
+
+__all__ = ["TypedTable"]

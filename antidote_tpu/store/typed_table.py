@@ -1,0 +1,295 @@
+"""Per-(shard, type) device table: key slots, snapshot versions, op rings.
+
+The tensor re-design of ``materializer_vnode``'s two ETS tables
+(/root/reference/src/materializer_vnode.erl:76): ``ops_cache`` becomes a
+fixed op ring per key slot, ``snapshot_cache`` a fixed ring of materialized
+snapshot versions.  All arrays carry a leading key-slot axis so a batch of
+reads/commits is a gather/scatter + one fold launch.
+
+Layout per table (N key slots, V versions, K ring slots, D clock lanes):
+
+  snap[f]     : [N, V, *field_shape]   materialized snapshot fields
+  snap_vc     : i32[N, V, D]           snapshot clocks
+  snap_seq    : i64[N, V]              insertion sequence (0 = empty)
+  ops_a       : i64[N, K, A]           effect payload lanes
+  ops_b       : i32[N, K, B]
+  ops_vc      : i32[N, K, D]           commit-augmented op clocks
+  ops_origin  : i32[N, K]              origin DC lane
+  n_ops       : host-mirrored i32[N]   valid ring prefix length
+
+GC policy (replaces op_insert_gc/snapshot_insert_gc,
+/root/reference/src/materializer_vnode.erl:513-647): when a key's ring
+would overflow, fold the whole ring at the shard's applied VC into a new
+snapshot version (evicting the oldest version) and reset the ring.  Folding
+only at the applied VC means stored snapshots never contain holes — the
+applied VC dominates every ring op by construction.
+
+Reads below the oldest retained coverage are *incomplete*; the caller falls
+back to a host-side log replay, mirroring the reference's
+``get_from_snapshot_log`` (/root/reference/src/materializer_vnode.erl:415-419).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.clock import orddict
+from antidote_tpu.clock import vector as vc
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt.base import CRDTType
+from antidote_tpu.materializer import fold as fold_mod
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]
+
+
+class TypedTable:
+    def __init__(self, ty: CRDTType, cfg: AntidoteConfig, n_rows: int | None = None):
+        self.ty = ty
+        self.cfg = cfg
+        self.n_rows = n_rows or cfg.keys_per_table
+        self.used_rows = 0
+        self.next_seq = 1
+        d, v, k = cfg.max_dcs, cfg.snap_versions, cfg.ops_per_key
+        a, b = ty.eff_a_width(cfg), ty.eff_b_width(cfg)
+        n = self.n_rows
+        spec = ty.state_spec(cfg)
+        self.snap = {
+            f: jnp.zeros((n, v) + shape, dtype) for f, (shape, dtype) in spec.items()
+        }
+        self.snap_vc = jnp.zeros((n, v, d), jnp.int32)
+        self.snap_seq = jnp.zeros((n, v), jnp.int64)
+        self.ops_a = jnp.zeros((n, k, a), jnp.int64)
+        self.ops_b = jnp.zeros((n, k, b), jnp.int32)
+        self.ops_vc = jnp.zeros((n, k, d), jnp.int32)
+        self.ops_origin = jnp.zeros((n, k), jnp.int32)
+        self.n_ops = np.zeros((n,), np.int32)  # host-authoritative mirror
+
+    # ------------------------------------------------------------------
+    # row allocation / growth
+    # ------------------------------------------------------------------
+    def alloc_row(self) -> int:
+        if self.used_rows == self.n_rows:
+            self._grow()
+        r = self.used_rows
+        self.used_rows += 1
+        return r
+
+    def _grow(self):
+        new_n = self.n_rows * 2
+
+        def grow(arr):
+            pad = [(0, new_n - self.n_rows)] + [(0, 0)] * (arr.ndim - 1)
+            return jnp.pad(arr, pad)
+
+        self.snap = {f: grow(x) for f, x in self.snap.items()}
+        self.snap_vc = grow(self.snap_vc)
+        self.snap_seq = grow(self.snap_seq)
+        self.ops_a = grow(self.ops_a)
+        self.ops_b = grow(self.ops_b)
+        self.ops_vc = grow(self.ops_vc)
+        self.ops_origin = grow(self.ops_origin)
+        self.n_ops = np.pad(self.n_ops, (0, new_n - self.n_rows))
+        self.n_rows = new_n
+
+    # ------------------------------------------------------------------
+    # device kernels (jitted per shape bucket)
+    # ------------------------------------------------------------------
+    @functools.lru_cache(maxsize=None)
+    def _append_fn(self):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def append(ops_a, ops_b, ops_vc_, ops_origin, rows, slots, a, b, v, o):
+            # out-of-range rows (padding) are dropped by the scatter
+            return (
+                ops_a.at[rows, slots].set(a, mode="drop"),
+                ops_b.at[rows, slots].set(b, mode="drop"),
+                ops_vc_.at[rows, slots].set(v, mode="drop"),
+                ops_origin.at[rows, slots].set(o, mode="drop"),
+            )
+
+        return append
+
+    @functools.lru_cache(maxsize=None)
+    def _read_fn(self):
+        ty, cfg = self.ty, self.cfg
+
+        @jax.jit
+        def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc_, ops_origin,
+                 rows, n_ops_rows, read_vcs):
+            svc = snap_vc[rows]            # [M, V, D]
+            sseq = snap_seq[rows]          # [M, V]
+            idx, found = orddict.get_smaller(svc, sseq, read_vcs)
+            m = rows.shape[0]
+            take = jnp.arange(m)
+            base_vc = jnp.where(found[:, None], svc[take, idx], 0)
+            base_state = {
+                f: jnp.where(
+                    found.reshape((m,) + (1,) * (x.ndim - 2)),
+                    x[rows][take, idx],
+                    jnp.zeros_like(x[rows][take, idx]),
+                )
+                for f, x in snap.items()
+            }
+            state, applied = fold_mod.fold_batch(
+                ty, cfg, base_state,
+                ops_a[rows], ops_b[rows], ops_vc_[rows], ops_origin[rows],
+                n_ops_rows, base_vc, read_vcs,
+            )
+            # complete ⟺ we had a base snapshot, or the key was never GC'd
+            # (no stored versions ⇒ the ring still holds the key's whole
+            # history and a bottom fold is exact)
+            never_gcd = jnp.max(sseq, axis=-1) == 0
+            complete = found | never_gcd
+            return state, applied, complete
+
+        return read
+
+    @functools.lru_cache(maxsize=None)
+    def _gc_fn(self):
+        ty, cfg = self.ty, self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def gc(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc_, ops_origin,
+               rows, n_ops_rows, new_seqs):
+            svc = snap_vc[rows]
+            sseq = snap_seq[rows]
+            m = rows.shape[0]
+            take = jnp.arange(m)
+            # Fold VC = per-lane max over the ring's valid ops and retained
+            # snapshot clocks.  Causal in-order delivery guarantees no op
+            # arriving later can be dominated by this merge, so the stored
+            # snapshot has no holes.
+            k = ops_vc_.shape[1]
+            valid = jnp.arange(k)[None, :] < n_ops_rows[:, None]      # [M, K]
+            ring_vc = jnp.where(valid[:, :, None], ops_vc_[rows], 0)  # [M, K, D]
+            ring_max = jnp.max(ring_vc, axis=1)                       # [M, D]
+            snap_valid = sseq > 0                                     # [M, V]
+            snap_max = jnp.max(
+                jnp.where(snap_valid[:, :, None], svc, 0), axis=1
+            )                                                         # [M, D]
+            read_vcs = jnp.maximum(ring_max, snap_max)
+            idx, found = orddict.get_smaller(svc, sseq, read_vcs)
+            base_vc = jnp.where(found[:, None], svc[take, idx], 0)
+            base_state = {
+                f: jnp.where(
+                    found.reshape((m,) + (1,) * (x.ndim - 2)),
+                    x[rows][take, idx],
+                    jnp.zeros_like(x[rows][take, idx]),
+                )
+                for f, x in snap.items()
+            }
+            state, _ = fold_mod.fold_batch(
+                ty, cfg, base_state,
+                ops_a[rows], ops_b[rows], ops_vc_[rows], ops_origin[rows],
+                n_ops_rows, base_vc, read_vcs,
+            )
+            slot = orddict.insert_slot(sseq)  # oldest version per row
+            snap2 = {
+                f: x.at[rows, slot].set(state[f], mode="drop")
+                for f, x in snap.items()
+            }
+            snap_vc2 = snap_vc.at[rows, slot].set(read_vcs, mode="drop")
+            snap_seq2 = snap_seq.at[rows, slot].set(new_seqs, mode="drop")
+            return snap2, snap_vc2, snap_seq2
+
+        return gc
+
+    # ------------------------------------------------------------------
+    # host API
+    # ------------------------------------------------------------------
+    def append(self, rows, eff_a, eff_b, vcs, origins, applied_vc=None):
+        """Append a commit-ordered batch of effects.
+
+        ``rows`` i64[M]; ``eff_a`` [M, A]; ``eff_b`` [M, B]; ``vcs`` [M, D];
+        ``origins`` [M].  Handles ring overflow by GC-folding full rings
+        first (``applied_vc`` is accepted for API compatibility but the GC
+        derives its own safe fold VC).
+        """
+        rows = np.asarray(rows, np.int64)
+        m = len(rows)
+        if m == 0:
+            return
+        k = self.cfg.ops_per_key
+        # per-op slot = current count + occurrence index of the row in batch
+        occ = np.zeros(m, np.int64)
+        counts: Dict[int, int] = {}
+        for i, r in enumerate(rows):
+            c = counts.get(r, 0)
+            occ[i] = c
+            counts[r] = c + 1
+        slots = self.n_ops[rows] + occ
+        over = slots >= k
+        if over.any():
+            # fold the overflowing rows' rings first, then retry
+            gc_rows = np.unique(rows[over])
+            self.gc(gc_rows)
+            slots = self.n_ops[rows] + occ
+            if (slots >= k).any():
+                raise OverflowError(
+                    f"more than {k} ops for one key in a single batch; "
+                    f"split the batch (type={self.ty.name})"
+                )
+        mb = _bucket(m, self.cfg.batch_buckets)
+        pad = mb - m
+        rows_p = np.concatenate([rows, np.full(pad, self.n_rows, np.int64)])
+        slots_p = np.concatenate([slots, np.zeros(pad, np.int64)])
+        a_p = np.concatenate([eff_a, np.zeros((pad,) + eff_a.shape[1:], np.int64)])
+        b_p = np.concatenate([eff_b, np.zeros((pad,) + eff_b.shape[1:], np.int32)])
+        v_p = np.concatenate([vcs, np.zeros((pad,) + vcs.shape[1:], np.int32)])
+        o_p = np.concatenate([origins, np.zeros(pad, np.int32)])
+        self.ops_a, self.ops_b, self.ops_vc, self.ops_origin = self._append_fn()(
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            rows_p, slots_p, a_p, b_p, v_p, o_p,
+        )
+        np.add.at(self.n_ops, rows, 1)
+
+    def gc(self, rows, applied_vc=None):
+        """Fold full rings into a fresh snapshot version and reset them."""
+        rows = np.unique(np.asarray(rows, np.int64))
+        m = len(rows)
+        if m == 0:
+            return
+        mb = _bucket(m, self.cfg.batch_buckets)
+        pad = mb - m
+        rows_p = np.concatenate([rows, np.full(pad, self.n_rows, np.int64)])
+        n_ops_p = np.concatenate([self.n_ops[rows], np.zeros(pad, np.int32)])
+        seqs = np.arange(self.next_seq, self.next_seq + m, dtype=np.int64)
+        self.next_seq += m
+        seqs_p = np.concatenate([seqs, np.zeros(pad, np.int64)])
+        self.snap, self.snap_vc, self.snap_seq = self._gc_fn()(
+            self.snap, self.snap_vc, self.snap_seq,
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            rows_p, n_ops_p, seqs_p,
+        )
+        self.n_ops[rows] = 0
+
+    def read(self, rows, read_vcs) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """Materialize a batch of keys at per-row read VCs.
+
+        Returns host copies: (state fields [M, ...], n_applied [M],
+        complete [M]).  Incomplete rows need a log-replay fallback.
+        """
+        rows = np.asarray(rows, np.int64)
+        read_vcs = np.asarray(read_vcs, np.int32)
+        m = len(rows)
+        mb = _bucket(m, self.cfg.batch_buckets)
+        pad = mb - m
+        rows_p = np.concatenate([rows, np.full(pad, 0, np.int64)])
+        vcs_p = np.concatenate([read_vcs, np.zeros((pad,) + read_vcs.shape[1:], np.int32)])
+        n_ops_p = np.concatenate([self.n_ops[rows], np.zeros(pad, np.int32)])
+        state, applied, complete = self._read_fn()(
+            self.snap, self.snap_vc, self.snap_seq,
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            rows_p, n_ops_p, vcs_p,
+        )
+        state = {f: np.asarray(x[:m]) for f, x in state.items()}
+        return state, np.asarray(applied[:m]), np.asarray(complete[:m])

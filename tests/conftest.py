@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware is unavailable in CI; the sharding layer is validated
+on virtual CPU devices (the driver separately dry-runs multi-chip via
+__graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cfg():
+    from antidote_tpu.config import AntidoteConfig
+
+    return AntidoteConfig(
+        n_shards=4, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=8, mv_slots=4, rga_slots=16, keys_per_table=64,
+        batch_buckets=(16, 64),
+    )

@@ -1,0 +1,67 @@
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.clock import vector as vc
+from antidote_tpu.clock import orddict
+
+
+def c(*xs):
+    return jnp.asarray(xs, jnp.int32)
+
+
+def test_partial_order():
+    a, b = c(1, 2, 3), c(2, 2, 3)
+    assert bool(vc.le(a, b))
+    assert not bool(vc.le(b, a))
+    assert bool(vc.lt(a, b))
+    assert not bool(vc.lt(a, a))
+    assert bool(vc.eq(a, a))
+
+
+def test_concurrent():
+    a, b = c(2, 0, 0), c(0, 3, 0)
+    assert bool(vc.concurrent(a, b))
+    assert not bool(vc.concurrent(a, a))
+    assert not bool(vc.concurrent(a, c(2, 1, 0)))
+
+
+def test_merge_min():
+    a, b = c(1, 5, 2), c(3, 1, 2)
+    assert (np.asarray(vc.merge(a, b)) == [3, 5, 2]).all()
+    assert (np.asarray(vc.vmin(a, b)) == [1, 1, 2]).all()
+
+
+def test_dominates_ignoring():
+    # inter_dc_dep_vnode gate: local VC must dominate with origin zeroed
+    local = c(5, 0, 2)
+    snap = c(5, 9, 1)
+    assert bool(vc.dominates_ignoring(local, snap, 1))
+    assert not bool(vc.dominates_ignoring(local, snap, 0))
+
+
+def test_broadcast_batched():
+    batch = jnp.stack([c(1, 1, 1), c(9, 9, 9)])
+    r = vc.le(batch, c(2, 2, 2))
+    assert list(np.asarray(r)) == [True, False]
+
+
+def test_get_smaller_picks_newest_dominated():
+    # versions: v0 at [1,0,0] seq 1; v1 at [2,0,0] seq 2
+    snap_vc = jnp.asarray([[[1, 0, 0], [2, 0, 0]]], jnp.int32)
+    snap_seq = jnp.asarray([[1, 2]], jnp.int64)
+    idx, found = orddict.get_smaller(snap_vc, snap_seq, c(2, 5, 5)[None])
+    assert bool(found[0]) and int(idx[0]) == 1
+    idx, found = orddict.get_smaller(snap_vc, snap_seq, c(1, 0, 0)[None])
+    assert bool(found[0]) and int(idx[0]) == 0
+    idx, found = orddict.get_smaller(snap_vc, snap_seq, c(0, 9, 9)[None])
+    assert not bool(found[0])
+
+
+def test_get_smaller_skips_empty_slots():
+    snap_vc = jnp.asarray([[[0, 0, 0], [2, 0, 0]]], jnp.int32)
+    snap_seq = jnp.asarray([[0, 5]], jnp.int64)  # slot 0 empty
+    idx, found = orddict.get_smaller(snap_vc, snap_seq, c(9, 9, 9)[None])
+    assert bool(found[0]) and int(idx[0]) == 1
+    # read below the only version: the zero-clock empty slot must NOT match
+    idx, found = orddict.get_smaller(snap_vc, snap_seq, c(1, 0, 0)[None])
+    assert not bool(found[0])
