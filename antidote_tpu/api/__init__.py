@@ -1,0 +1,4 @@
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.txn.manager import AbortError
+
+__all__ = ["AntidoteNode", "AbortError"]

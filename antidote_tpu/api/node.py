@@ -1,0 +1,88 @@
+"""AntidoteNode — the public API facade.
+
+The surface of ``antidote.erl`` (/root/reference/src/antidote.erl:36-54):
+static & interactive transactions, typed bound objects, hook registration —
+over one replica's TransactionManager + KVStore.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt import is_type
+from antidote_tpu.store.kv import KVStore
+from antidote_tpu.txn.manager import (
+    AbortError,
+    Transaction,
+    TransactionManager,
+    Update,
+)
+
+BoundObject = Any
+
+
+class AntidoteNode:
+    """One replica ("DC") of the store.
+
+    ``dc_id`` is the dense clock lane of this replica (the dcid→lane
+    registry replacing Antidote's dict VCs keyed by dcid).
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[AntidoteConfig] = None,
+        dc_id: int = 0,
+        sharding=None,
+        cert: bool = True,
+    ):
+        self.cfg = cfg or AntidoteConfig()
+        self.dc_id = dc_id
+        self.store = KVStore(self.cfg, sharding=sharding)
+        self.txm = TransactionManager(self.store, my_dc=dc_id, cert=cert)
+
+    # --- transactions (antidote.erl:36-54) -----------------------------
+    def start_transaction(self, clock=None, props=None) -> Transaction:
+        return self.txm.start_transaction(clock, props)
+
+    def read_objects(self, objects: Sequence, txn: Optional[Transaction] = None,
+                     clock=None):
+        if txn is not None:
+            return self.txm.read_objects(objects, txn)
+        return self.txm.read_objects_static(objects, clock)
+
+    def update_objects(self, updates: Sequence[Update],
+                       txn: Optional[Transaction] = None, clock=None):
+        if txn is not None:
+            self.txm.update_objects(updates, txn)
+            return None
+        return self.txm.update_objects_static(updates, clock)
+
+    def commit_transaction(self, txn: Transaction) -> np.ndarray:
+        return self.txm.commit_transaction(txn)
+
+    def abort_transaction(self, txn: Transaction) -> None:
+        self.txm.abort_transaction(txn)
+
+    # --- hooks (antidote.erl register_pre/post_hook) -------------------
+    def register_pre_hook(self, bucket: str, fn) -> None:
+        self.txm.hooks.register_pre_hook(bucket, fn)
+
+    def register_post_hook(self, bucket: str, fn) -> None:
+        self.txm.hooks.register_post_hook(bucket, fn)
+
+    def unregister_hook(self, kind: str, bucket: str) -> None:
+        self.txm.hooks.unregister_hook(kind, bucket)
+
+    # --- introspection -------------------------------------------------
+    @staticmethod
+    def is_type(type_name: str) -> bool:
+        return is_type(type_name)
+
+    def stable_vc(self) -> np.ndarray:
+        return self.store.stable_vc()
+
+
+__all__ = ["AntidoteNode", "AbortError"]

@@ -85,7 +85,11 @@ class RegisterMV(CRDTType):
 
     def state_spec(self, cfg):
         k = cfg.mv_slots
-        return {"vals": ((k,), jnp.int64), "ids": ((k,), jnp.int64)}
+        return {
+            "vals": ((k,), jnp.int64),
+            "ids": ((k,), jnp.int64),
+            "ovf": ((), jnp.int32),
+        }
 
     def is_operation(self, op):
         return op[0] == "assign"
@@ -104,6 +108,9 @@ class RegisterMV(CRDTType):
         return [(a, pack_b([], width=self.eff_b_width(cfg)), [(h, blobs.bytes_of(h))])]
 
     def value(self, state, blobs, cfg):
+        from antidote_tpu.crdt.sets import _warn_overflow
+
+        _warn_overflow(self.name, state)
         vals = np.asarray(state["vals"])
         ids = np.asarray(state["ids"])
         out = [blobs.resolve(int(v)) for v, i in zip(vals, ids) if i != 0]
@@ -128,4 +135,8 @@ class RegisterMV(CRDTType):
         has_free = jnp.any(free)
         ids2 = jnp.where(has_free, ids1.at[slot].set(new_id), ids1)
         vals2 = jnp.where(has_free, vals1.at[slot].set(h), vals1)
-        return {"vals": vals2, "ids": ids2}
+        return {
+            "vals": vals2,
+            "ids": ids2,
+            "ovf": state["ovf"] + (~has_free).astype(jnp.int32),
+        }

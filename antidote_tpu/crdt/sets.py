@@ -33,6 +33,22 @@ from antidote_tpu.crdt.base import CRDTType, Effect, pack_b
 from antidote_tpu.crdt.blob import EMPTY_HANDLE
 
 
+def _warn_overflow(type_name, state):
+    """Surface element-slot exhaustion (device apply drops the op and bumps
+    the ``ovf`` counter).  Raising here would make the key unreadable;
+    instead we warn loudly — growth + WAL replay is the recovery path."""
+    ovf = int(np.asarray(state.get("ovf", 0)))
+    if ovf > 0:
+        import warnings
+
+        warnings.warn(
+            f"{type_name}: {ovf} op(s) dropped — cfg.set_slots exhausted "
+            "for this key; increase set_slots (data until then is truncated)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def _elem_effects(op, blobs, make):
     kind, arg = op
     if kind.endswith("_all"):
@@ -59,6 +75,7 @@ class SetAW(CRDTType):
             "elems": ((e,), jnp.int64),
             "addvc": ((e, d), jnp.int32),
             "rmvc": ((e, d), jnp.int32),
+            "ovf": ((), jnp.int32),  # adds dropped for lack of a free slot
         }
 
     def is_operation(self, op):
@@ -87,6 +104,7 @@ class SetAW(CRDTType):
         return _elem_effects(op, blobs, make)
 
     def value(self, state, blobs, cfg):
+        _warn_overflow(self.name, state)
         elems = np.asarray(state["elems"])
         present = np.any(
             np.asarray(state["addvc"]) > np.asarray(state["rmvc"]), axis=-1
@@ -124,10 +142,12 @@ class SetAW(CRDTType):
         rm_row = jnp.maximum(rmvc[idx_match], obs)
         rmvc_r = jnp.where(has_match, rmvc.at[idx_match].set(rm_row), rmvc)
 
+        dropped = ~is_rm & ~can_add
         return {
             "elems": jnp.where(is_rm, elems, elems_a),
             "addvc": jnp.where(is_rm, addvc, addvc_a),
             "rmvc": jnp.where(is_rm, rmvc_r, rmvc_a),
+            "ovf": state["ovf"] + dropped.astype(jnp.int32),
         }
 
 
@@ -150,6 +170,7 @@ class SetRW(CRDTType):
             "elems": ((e,), jnp.int64),
             "addvc": ((e, d), jnp.int32),
             "rmvc": ((e, d), jnp.int32),
+            "ovf": ((), jnp.int32),
         }
 
     def is_operation(self, op):
@@ -184,6 +205,7 @@ class SetRW(CRDTType):
         return (np.asarray(elems) != EMPTY_HANDLE) & has_add & covered
 
     def value(self, state, blobs, cfg):
+        _warn_overflow(self.name, state)
         elems = np.asarray(state["elems"])
         present = self._present(elems, state["addvc"], state["rmvc"])
         return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
@@ -219,10 +241,12 @@ class SetRW(CRDTType):
         elems_r = jnp.where(can_rm, elems.at[idx_rm].set(h), elems)
         rmvc_r = jnp.where(can_rm, rmvc.at[idx_rm].set(row_rm), rmvc)
 
+        dropped = jnp.where(is_rm, ~can_rm, ~can_add)
         return {
             "elems": jnp.where(is_rm, elems_r, elems_a),
             "addvc": jnp.where(is_rm, addvc, addvc_a),
             "rmvc": jnp.where(is_rm, rmvc_r, rmvc),
+            "ovf": state["ovf"] + dropped.astype(jnp.int32),
         }
 
 
@@ -234,7 +258,7 @@ class SetGO(CRDTType):
 
     def state_spec(self, cfg):
         e = cfg.set_slots
-        return {"elems": ((e,), jnp.int64)}
+        return {"elems": ((e,), jnp.int64), "ovf": ((), jnp.int32)}
 
     def is_operation(self, op):
         return op[0] in ("add", "add_all")
@@ -253,6 +277,7 @@ class SetGO(CRDTType):
         return _elem_effects(op, blobs, make)
 
     def value(self, state, blobs, cfg):
+        _warn_overflow(self.name, state)
         elems = np.asarray(state["elems"])
         return sorted(
             (blobs.resolve(int(h)) for h in elems[elems != EMPTY_HANDLE]), key=repr
@@ -266,4 +291,8 @@ class SetGO(CRDTType):
         free = elems == EMPTY_HANDLE
         idx = jnp.argmax(free)
         do_insert = ~has_match & jnp.any(free)
-        return {"elems": jnp.where(do_insert, elems.at[idx].set(h), elems)}
+        dropped = ~has_match & ~jnp.any(free)
+        return {
+            "elems": jnp.where(do_insert, elems.at[idx].set(h), elems),
+            "ovf": state["ovf"] + dropped.astype(jnp.int32),
+        }

@@ -1,0 +1,3 @@
+from antidote_tpu.parallel.spmd import make_mesh, shard_axis_sharding, sharded_step_fn
+
+__all__ = ["make_mesh", "shard_axis_sharding", "sharded_step_fn"]

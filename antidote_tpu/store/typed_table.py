@@ -1,31 +1,38 @@
-"""Per-(shard, type) device table: key slots, snapshot versions, op rings.
+"""Per-type sharded device table: key slots, snapshot versions, op rings.
 
 The tensor re-design of ``materializer_vnode``'s two ETS tables
 (/root/reference/src/materializer_vnode.erl:76): ``ops_cache`` becomes a
 fixed op ring per key slot, ``snapshot_cache`` a fixed ring of materialized
-snapshot versions.  All arrays carry a leading key-slot axis so a batch of
-reads/commits is a gather/scatter + one fold launch.
+snapshot versions.  The riak_core ring (16 partitions by default,
+/root/reference/config/vars.config:5) becomes a leading shard axis ``P`` on
+every array; device kernels are per-shard bodies vmapped over that axis, so
+when the arrays are laid out over a ``Mesh(('shard',))`` XLA partitions the
+batch across devices with no cross-device traffic on the data plane.
 
-Layout per table (N key slots, V versions, K ring slots, D clock lanes):
+Layout per type (P shards, N key slots, V versions, K ring slots, D lanes):
 
-  snap[f]     : [N, V, *field_shape]   materialized snapshot fields
-  snap_vc     : i32[N, V, D]           snapshot clocks
-  snap_seq    : i64[N, V]              insertion sequence (0 = empty)
-  ops_a       : i64[N, K, A]           effect payload lanes
-  ops_b       : i32[N, K, B]
-  ops_vc      : i32[N, K, D]           commit-augmented op clocks
-  ops_origin  : i32[N, K]              origin DC lane
-  n_ops       : host-mirrored i32[N]   valid ring prefix length
+  snap[f]     : [P, N, V, *field_shape]   materialized snapshot fields
+  snap_vc     : i32[P, N, V, D]           snapshot clocks
+  snap_seq    : i64[P, N, V]              insertion sequence (0 = empty)
+  ops_a       : i64[P, N, K, A]           effect payload lanes
+  ops_b       : i32[P, N, K, B]
+  ops_vc      : i32[P, N, K, D]           commit-augmented op clocks
+  ops_origin  : i32[P, N, K]              origin DC lane
+  n_ops       : host-mirrored i32[P, N]   valid ring prefix length
 
-GC policy (replaces op_insert_gc/snapshot_insert_gc,
+Host API is flat — (shards[M], rows[M], ...) — and is routed into padded
+``[P, M']`` per-shard blocks internally.  Padding uses out-of-range indices:
+scatters drop them (mode="drop"), gathers clip and the caller masks.
+
+GC policy (replaces op_insert_gc / snapshot_insert_gc,
 /root/reference/src/materializer_vnode.erl:513-647): when a key's ring
-would overflow, fold the whole ring at the shard's applied VC into a new
-snapshot version (evicting the oldest version) and reset the ring.  Folding
-only at the applied VC means stored snapshots never contain holes — the
-applied VC dominates every ring op by construction.
+would overflow, fold the whole ring into a new snapshot version (evicting
+the oldest) at a self-derived safe VC — the per-lane max of ring-op and
+retained-snapshot clocks.  Causal in-order delivery guarantees no later op
+can be dominated by that merge, so stored snapshots never contain holes.
 
-Reads below the oldest retained coverage are *incomplete*; the caller falls
-back to a host-side log replay, mirroring the reference's
+Reads below the oldest retained coverage are flagged *incomplete*; the
+caller falls back to a host-side log replay, mirroring the reference's
 ``get_from_snapshot_log`` (/root/reference/src/materializer_vnode.erl:415-419).
 """
 
@@ -39,7 +46,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from antidote_tpu.clock import orddict
-from antidote_tpu.clock import vector as vc
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt.base import CRDTType
 from antidote_tpu.materializer import fold as fold_mod
@@ -52,44 +58,169 @@ def _bucket(n: int, buckets) -> int:
     return ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]
 
 
+def _shard_head_update_body(ty, cfg):
+    """Per-shard write-time fold: apply ring slots [start, end) of each
+    touched key onto its *head* state (the eagerly-materialized snapshot at
+    the key's full applied history).  This is the write-side analogue of
+    the reference pushing committed ops into the materializer at commit
+    time (clocksi_vnode:update_materializer,
+    /root/reference/src/clocksi_vnode.erl:634-657) — paying the fold once
+    per commit so hot reads are pure gathers."""
+
+    def update(head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
+               rows, starts, ends):
+        def one(h, hvc, a, b, v, o, start, end):
+            k = v.shape[0]
+
+            def step(carry, xs):
+                state, cvc = carry
+                ea, eb, op_vc, origin, slot = xs
+                include = (slot >= start) & (slot < end)
+                new = ty.apply(cfg, state, ea, eb, op_vc, origin)
+                merged = jax.tree.map(
+                    lambda n_, o_: jnp.where(include, n_, o_), new, state
+                )
+                cvc = jnp.where(include, jnp.maximum(cvc, op_vc), cvc)
+                return (merged, cvc), None
+
+            (state, cvc), _ = jax.lax.scan(
+                step, (h, hvc),
+                (a, b, v, o, jnp.arange(k, dtype=jnp.int32)),
+            )
+            return state, cvc
+
+        n = head_vc.shape[0]
+        rc = jnp.minimum(rows, n - 1)  # clip padding for gathers
+        h_rows = {f: x[rc] for f, x in head.items()}
+        state, cvc = jax.vmap(one)(
+            h_rows, head_vc[rc],
+            ops_a[rc], ops_b[rc], ops_vc[rc], ops_origin[rc],
+            starts, ends,
+        )
+        # scatter with the UNclipped rows: padding (out-of-range) drops
+        head2 = {f: x.at[rows].set(state[f], mode="drop") for f, x in head.items()}
+        head_vc2 = head_vc.at[rows].set(cvc, mode="drop")
+        return head2, head_vc2
+
+    return update
+
+
+def _shard_read_latest_body(ty, cfg):
+    """Per-shard fast read: gather head rows; a row is *fresh* iff its head
+    VC is dominated by the read VC (then head == the exact snapshot).
+    Stale rows must take the versioned fold path."""
+
+    def read(head, head_vc, rows, read_vcs):
+        hvc = head_vc[rows]
+        state = {f: x[rows] for f, x in head.items()}
+        fresh = jnp.all(hvc <= read_vcs, axis=-1)
+        return state, fresh
+
+    return read
+
+
+def _shard_read_body(ty, cfg):
+    """Per-shard read kernel: operates on one shard's block."""
+
+    def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
+             rows, n_ops_rows, read_vcs):
+        svc = snap_vc[rows]            # [M, V, D]
+        sseq = snap_seq[rows]          # [M, V]
+        idx, found = orddict.get_smaller(svc, sseq, read_vcs)
+        m = rows.shape[0]
+        take = jnp.arange(m)
+        base_vc = jnp.where(found[:, None], svc[take, idx], 0)
+        base_state = {
+            f: jnp.where(
+                found.reshape((m,) + (1,) * (x.ndim - 2)),
+                x[rows][take, idx],
+                jnp.zeros_like(x[rows][take, idx]),
+            )
+            for f, x in snap.items()
+        }
+        state, applied = fold_mod.fold_batch(
+            ty, cfg, base_state,
+            ops_a[rows], ops_b[rows], ops_vc[rows], ops_origin[rows],
+            n_ops_rows, base_vc, read_vcs,
+        )
+        # complete ⟺ the key was never GC'd (ring holds its whole history),
+        # or the selected base is the NEWEST retained version — the ring
+        # only holds ops after the newest version, so folding onto an older
+        # version would silently miss the ops GC'd into newer ones.
+        never_gcd = jnp.max(sseq, axis=-1) == 0
+        newest = jnp.max(sseq, axis=-1)
+        picked_newest = found & (sseq[take, idx] == newest)
+        complete = picked_newest | never_gcd
+        return state, applied, complete
+
+    return read
+
+
 class TypedTable:
-    def __init__(self, ty: CRDTType, cfg: AntidoteConfig, n_rows: int | None = None):
+    """Host handle for one CRDT type's sharded device arrays."""
+
+    def __init__(
+        self,
+        ty: CRDTType,
+        cfg: AntidoteConfig,
+        n_rows: int | None = None,
+        n_shards: int | None = None,
+        sharding=None,
+    ):
         self.ty = ty
         self.cfg = cfg
         self.n_rows = n_rows or cfg.keys_per_table
-        self.used_rows = 0
+        self.n_shards = n_shards or cfg.n_shards
+        self.sharding = sharding
+        self.used_rows = np.zeros((self.n_shards,), np.int64)
         self.next_seq = 1
         d, v, k = cfg.max_dcs, cfg.snap_versions, cfg.ops_per_key
         a, b = ty.eff_a_width(cfg), ty.eff_b_width(cfg)
-        n = self.n_rows
+        p, n = self.n_shards, self.n_rows
         spec = ty.state_spec(cfg)
+
+        def mk(shape, dtype):
+            arr = jnp.zeros(shape, dtype)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            return arr
+
         self.snap = {
-            f: jnp.zeros((n, v) + shape, dtype) for f, (shape, dtype) in spec.items()
+            f: mk((p, n, v) + shape, dtype) for f, (shape, dtype) in spec.items()
         }
-        self.snap_vc = jnp.zeros((n, v, d), jnp.int32)
-        self.snap_seq = jnp.zeros((n, v), jnp.int64)
-        self.ops_a = jnp.zeros((n, k, a), jnp.int64)
-        self.ops_b = jnp.zeros((n, k, b), jnp.int32)
-        self.ops_vc = jnp.zeros((n, k, d), jnp.int32)
-        self.ops_origin = jnp.zeros((n, k), jnp.int32)
-        self.n_ops = np.zeros((n,), np.int32)  # host-authoritative mirror
+        self.snap_vc = mk((p, n, v, d), jnp.int32)
+        self.snap_seq = mk((p, n, v), jnp.int64)
+        self.ops_a = mk((p, n, k, a), jnp.int64)
+        self.ops_b = mk((p, n, k, b), jnp.int32)
+        self.ops_vc = mk((p, n, k, d), jnp.int32)
+        self.ops_origin = mk((p, n, k), jnp.int32)
+        self.n_ops = np.zeros((p, n), np.int32)  # host-authoritative mirror
+        # head = eagerly-materialized state at each key's full applied
+        # history (folded at append time; reads at VC ≥ head_vc are gathers)
+        self.head = {
+            f: mk((p, n) + shape, dtype) for f, (shape, dtype) in spec.items()
+        }
+        self.head_vc = mk((p, n, d), jnp.int32)
 
     # ------------------------------------------------------------------
     # row allocation / growth
     # ------------------------------------------------------------------
-    def alloc_row(self) -> int:
-        if self.used_rows == self.n_rows:
+    def alloc_row(self, shard: int) -> int:
+        if self.used_rows[shard] == self.n_rows:
             self._grow()
-        r = self.used_rows
-        self.used_rows += 1
+        r = int(self.used_rows[shard])
+        self.used_rows[shard] += 1
         return r
 
     def _grow(self):
         new_n = self.n_rows * 2
 
         def grow(arr):
-            pad = [(0, new_n - self.n_rows)] + [(0, 0)] * (arr.ndim - 1)
-            return jnp.pad(arr, pad)
+            pad = [(0, 0), (0, new_n - self.n_rows)] + [(0, 0)] * (arr.ndim - 2)
+            out = jnp.pad(arr, pad)
+            if self.sharding is not None:
+                out = jax.device_put(out, self.sharding)
+            return out
 
         self.snap = {f: grow(x) for f, x in self.snap.items()}
         self.snap_vc = grow(self.snap_vc)
@@ -98,141 +229,148 @@ class TypedTable:
         self.ops_b = grow(self.ops_b)
         self.ops_vc = grow(self.ops_vc)
         self.ops_origin = grow(self.ops_origin)
-        self.n_ops = np.pad(self.n_ops, (0, new_n - self.n_rows))
+        self.head = {f: grow(x) for f, x in self.head.items()}
+        self.head_vc = grow(self.head_vc)
+        self.n_ops = np.pad(self.n_ops, ((0, 0), (0, new_n - self.n_rows)))
         self.n_rows = new_n
 
     # ------------------------------------------------------------------
-    # device kernels (jitted per shape bucket)
+    # device kernels
     # ------------------------------------------------------------------
-    @functools.lru_cache(maxsize=None)
+    @functools.cached_property
     def _append_fn(self):
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def append(ops_a, ops_b, ops_vc_, ops_origin, rows, slots, a, b, v, o):
-            # out-of-range rows (padding) are dropped by the scatter
+        def append(ops_a, ops_b, ops_vc, ops_origin, shards, rows, slots, a, b, v, o):
+            # out-of-range indices (padding) are dropped by the scatter
             return (
-                ops_a.at[rows, slots].set(a, mode="drop"),
-                ops_b.at[rows, slots].set(b, mode="drop"),
-                ops_vc_.at[rows, slots].set(v, mode="drop"),
-                ops_origin.at[rows, slots].set(o, mode="drop"),
+                ops_a.at[shards, rows, slots].set(a, mode="drop"),
+                ops_b.at[shards, rows, slots].set(b, mode="drop"),
+                ops_vc.at[shards, rows, slots].set(v, mode="drop"),
+                ops_origin.at[shards, rows, slots].set(o, mode="drop"),
             )
 
         return append
 
-    @functools.lru_cache(maxsize=None)
+    @functools.cached_property
     def _read_fn(self):
-        ty, cfg = self.ty, self.cfg
+        body = _shard_read_body(self.ty, self.cfg)
 
         @jax.jit
-        def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc_, ops_origin,
+        def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
                  rows, n_ops_rows, read_vcs):
-            svc = snap_vc[rows]            # [M, V, D]
-            sseq = snap_seq[rows]          # [M, V]
-            idx, found = orddict.get_smaller(svc, sseq, read_vcs)
-            m = rows.shape[0]
-            take = jnp.arange(m)
-            base_vc = jnp.where(found[:, None], svc[take, idx], 0)
-            base_state = {
-                f: jnp.where(
-                    found.reshape((m,) + (1,) * (x.ndim - 2)),
-                    x[rows][take, idx],
-                    jnp.zeros_like(x[rows][take, idx]),
-                )
-                for f, x in snap.items()
-            }
-            state, applied = fold_mod.fold_batch(
-                ty, cfg, base_state,
-                ops_a[rows], ops_b[rows], ops_vc_[rows], ops_origin[rows],
-                n_ops_rows, base_vc, read_vcs,
+            return jax.vmap(body)(
+                snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
+                rows, n_ops_rows, read_vcs,
             )
-            # complete ⟺ we had a base snapshot, or the key was never GC'd
-            # (no stored versions ⇒ the ring still holds the key's whole
-            # history and a bottom fold is exact)
-            never_gcd = jnp.max(sseq, axis=-1) == 0
-            complete = found | never_gcd
-            return state, applied, complete
 
         return read
 
-    @functools.lru_cache(maxsize=None)
+    @functools.cached_property
     def _gc_fn(self):
-        ty, cfg = self.ty, self.cfg
-
+        # GC = copy the head (already the exact fold of the full ring +
+        # prior history) into a fresh snapshot version; no fold needed.
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def gc(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc_, ops_origin,
-               rows, n_ops_rows, new_seqs):
-            svc = snap_vc[rows]
-            sseq = snap_seq[rows]
-            m = rows.shape[0]
-            take = jnp.arange(m)
-            # Fold VC = per-lane max over the ring's valid ops and retained
-            # snapshot clocks.  Causal in-order delivery guarantees no op
-            # arriving later can be dominated by this merge, so the stored
-            # snapshot has no holes.
-            k = ops_vc_.shape[1]
-            valid = jnp.arange(k)[None, :] < n_ops_rows[:, None]      # [M, K]
-            ring_vc = jnp.where(valid[:, :, None], ops_vc_[rows], 0)  # [M, K, D]
-            ring_max = jnp.max(ring_vc, axis=1)                       # [M, D]
-            snap_valid = sseq > 0                                     # [M, V]
-            snap_max = jnp.max(
-                jnp.where(snap_valid[:, :, None], svc, 0), axis=1
-            )                                                         # [M, D]
-            read_vcs = jnp.maximum(ring_max, snap_max)
-            idx, found = orddict.get_smaller(svc, sseq, read_vcs)
-            base_vc = jnp.where(found[:, None], svc[take, idx], 0)
-            base_state = {
-                f: jnp.where(
-                    found.reshape((m,) + (1,) * (x.ndim - 2)),
-                    x[rows][take, idx],
-                    jnp.zeros_like(x[rows][take, idx]),
-                )
-                for f, x in snap.items()
-            }
-            state, _ = fold_mod.fold_batch(
-                ty, cfg, base_state,
-                ops_a[rows], ops_b[rows], ops_vc_[rows], ops_origin[rows],
-                n_ops_rows, base_vc, read_vcs,
+        def gc(snap, snap_vc, snap_seq, head, head_vc, rows, new_seqs):
+            def per_shard(snap, snap_vc, snap_seq, head, head_vc, rows, seqs):
+                from antidote_tpu.clock import orddict
+
+                sseq = snap_seq[rows]
+                slot = orddict.insert_slot(sseq)
+                snap2 = {
+                    f: x.at[rows, slot].set(head[f][rows], mode="drop")
+                    for f, x in snap.items()
+                }
+                snap_vc2 = snap_vc.at[rows, slot].set(head_vc[rows], mode="drop")
+                snap_seq2 = snap_seq.at[rows, slot].set(seqs, mode="drop")
+                return snap2, snap_vc2, snap_seq2
+
+            return jax.vmap(per_shard)(
+                snap, snap_vc, snap_seq, head, head_vc, rows, new_seqs
             )
-            slot = orddict.insert_slot(sseq)  # oldest version per row
-            snap2 = {
-                f: x.at[rows, slot].set(state[f], mode="drop")
-                for f, x in snap.items()
-            }
-            snap_vc2 = snap_vc.at[rows, slot].set(read_vcs, mode="drop")
-            snap_seq2 = snap_seq.at[rows, slot].set(new_seqs, mode="drop")
-            return snap2, snap_vc2, snap_seq2
 
         return gc
 
+    @functools.cached_property
+    def _head_update_fn(self):
+        body = _shard_head_update_body(self.ty, self.cfg)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def upd(head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
+                rows, starts, ends):
+            return jax.vmap(body)(
+                head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
+                rows, starts, ends,
+            )
+
+        return upd
+
+    @functools.cached_property
+    def _read_latest_fn(self):
+        body = _shard_read_latest_body(self.ty, self.cfg)
+
+        @jax.jit
+        def read(head, head_vc, rows, read_vcs):
+            return jax.vmap(body)(head, head_vc, rows, read_vcs)
+
+        return read
+
     # ------------------------------------------------------------------
-    # host API
+    # host routing helpers
     # ------------------------------------------------------------------
-    def append(self, rows, eff_a, eff_b, vcs, origins, applied_vc=None):
+    def _route(self, shards, rows):
+        """Group a flat (shard, row) batch into padded [P, M'] blocks.
+
+        Returns (row_mat i64[P, M'], pos — list of (shard, slot) per input).
+        Padding rows use index ``n_rows`` (dropped/clipped on device).
+        """
+        p = self.n_shards
+        mtot = len(shards)
+        counts = np.bincount(shards, minlength=p)
+        m = _bucket(max(int(counts.max()), 1), self.cfg.batch_buckets)
+        order = np.argsort(shards, kind="stable")
+        sorted_shards = shards[order]
+        starts = np.searchsorted(sorted_shards, np.arange(p))
+        slot_in_shard = np.arange(mtot) - starts[sorted_shards]
+        row_mat = np.full((p, m), self.n_rows, np.int64)
+        row_mat[sorted_shards, slot_in_shard] = rows[order]
+        pos = np.empty((mtot, 2), np.int64)
+        pos[order, 0] = sorted_shards
+        pos[order, 1] = slot_in_shard
+        return row_mat, pos
+
+    # ------------------------------------------------------------------
+    # host API (flat batches)
+    # ------------------------------------------------------------------
+    def append(self, shards, rows, eff_a, eff_b, vcs, origins):
         """Append a commit-ordered batch of effects.
 
-        ``rows`` i64[M]; ``eff_a`` [M, A]; ``eff_b`` [M, B]; ``vcs`` [M, D];
-        ``origins`` [M].  Handles ring overflow by GC-folding full rings
-        first (``applied_vc`` is accepted for API compatibility but the GC
-        derives its own safe fold VC).
+        ``shards`` i64[M]; ``rows`` i64[M]; ``eff_a`` [M, A]; ``eff_b``
+        [M, B]; ``vcs`` [M, D]; ``origins`` [M].  Ring overflow triggers a
+        GC fold of the affected keys first.
         """
+        shards = np.asarray(shards, np.int64)
         rows = np.asarray(rows, np.int64)
         m = len(rows)
         if m == 0:
             return
         k = self.cfg.ops_per_key
-        # per-op slot = current count + occurrence index of the row in batch
-        occ = np.zeros(m, np.int64)
-        counts: Dict[int, int] = {}
-        for i, r in enumerate(rows):
-            c = counts.get(r, 0)
-            occ[i] = c
-            counts[r] = c + 1
-        slots = self.n_ops[rows] + occ
+        # occurrence index of each (shard, row) within the batch, vectorized
+        combined = shards * np.int64(self.n_rows) + rows
+        order = np.argsort(combined, kind="stable")
+        sorted_c = combined[order]
+        group_start = np.concatenate([[0], np.nonzero(np.diff(sorted_c))[0] + 1])
+        group_of = np.cumsum(
+            np.concatenate([[0], (np.diff(sorted_c) != 0).astype(np.int64)])
+        )
+        occ = np.empty(m, np.int64)
+        occ[order] = np.arange(m) - group_start[group_of]
+        slots = self.n_ops[shards, rows] + occ
         over = slots >= k
         if over.any():
-            # fold the overflowing rows' rings first, then retry
-            gc_rows = np.unique(rows[over])
-            self.gc(gc_rows)
-            slots = self.n_ops[rows] + occ
+            su, ru = shards[over], rows[over]
+            uniq = np.unique(np.stack([su, ru], axis=1), axis=0)
+            self.gc(uniq[:, 0], uniq[:, 1])
+            slots = self.n_ops[shards, rows] + occ
             if (slots >= k).any():
                 raise OverflowError(
                     f"more than {k} ops for one key in a single batch; "
@@ -240,56 +378,102 @@ class TypedTable:
                 )
         mb = _bucket(m, self.cfg.batch_buckets)
         pad = mb - m
-        rows_p = np.concatenate([rows, np.full(pad, self.n_rows, np.int64)])
-        slots_p = np.concatenate([slots, np.zeros(pad, np.int64)])
-        a_p = np.concatenate([eff_a, np.zeros((pad,) + eff_a.shape[1:], np.int64)])
-        b_p = np.concatenate([eff_b, np.zeros((pad,) + eff_b.shape[1:], np.int32)])
-        v_p = np.concatenate([vcs, np.zeros((pad,) + vcs.shape[1:], np.int32)])
-        o_p = np.concatenate([origins, np.zeros(pad, np.int32)])
-        self.ops_a, self.ops_b, self.ops_vc, self.ops_origin = self._append_fn()(
-            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
-            rows_p, slots_p, a_p, b_p, v_p, o_p,
-        )
-        np.add.at(self.n_ops, rows, 1)
 
-    def gc(self, rows, applied_vc=None):
-        """Fold full rings into a fresh snapshot version and reset them."""
-        rows = np.unique(np.asarray(rows, np.int64))
-        m = len(rows)
-        if m == 0:
+        def padi(x, fill):
+            return np.concatenate([x, np.full((pad,) + x.shape[1:], fill, x.dtype)])
+
+        self.ops_a, self.ops_b, self.ops_vc, self.ops_origin = self._append_fn(
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            padi(shards, self.n_shards), padi(rows, 0), padi(slots, 0),
+            padi(np.asarray(eff_a, np.int64), 0),
+            padi(np.asarray(eff_b, np.int32), 0),
+            padi(np.asarray(vcs, np.int32), 0),
+            padi(np.asarray(origins, np.int32), 0),
+        )
+        # fold the newly-appended ring slots onto the head state
+        uniq_mask = occ == 0
+        us, ur = shards[uniq_mask], rows[uniq_mask]
+        ucount = np.bincount(
+            np.searchsorted(np.sort(combined[uniq_mask]), combined)
+        )  # per-unique-pair op count, aligned to sorted unique order
+        sort_u = np.argsort(combined[uniq_mask], kind="stable")
+        us_s, ur_s = us[sort_u], ur[sort_u]
+        starts = self.n_ops[us_s, ur_s].astype(np.int64)
+        ends = starts + ucount
+        row_mat, pos = self._route(us_s, ur_s)
+        start_mat = np.zeros(row_mat.shape, np.int64)
+        end_mat = np.zeros(row_mat.shape, np.int64)
+        start_mat[pos[:, 0], pos[:, 1]] = starts
+        end_mat[pos[:, 0], pos[:, 1]] = ends
+        self.head, self.head_vc = self._head_update_fn(
+            self.head, self.head_vc,
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            row_mat, start_mat, end_mat,
+        )
+        np.add.at(self.n_ops, (shards, rows), 1)
+
+    def gc(self, shards, rows):
+        """Fold the given keys' rings into a fresh snapshot version."""
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        if len(rows) == 0:
             return
-        mb = _bucket(m, self.cfg.batch_buckets)
-        pad = mb - m
-        rows_p = np.concatenate([rows, np.full(pad, self.n_rows, np.int64)])
-        n_ops_p = np.concatenate([self.n_ops[rows], np.zeros(pad, np.int32)])
-        seqs = np.arange(self.next_seq, self.next_seq + m, dtype=np.int64)
-        self.next_seq += m
-        seqs_p = np.concatenate([seqs, np.zeros(pad, np.int64)])
-        self.snap, self.snap_vc, self.snap_seq = self._gc_fn()(
+        row_mat, pos = self._route(shards, rows)
+        count = len(rows)
+        seq_mat = np.zeros(row_mat.shape, np.int64)
+        seqs = np.arange(self.next_seq, self.next_seq + count, dtype=np.int64)
+        self.next_seq += count
+        seq_mat[pos[:, 0], pos[:, 1]] = seqs
+        self.snap, self.snap_vc, self.snap_seq = self._gc_fn(
             self.snap, self.snap_vc, self.snap_seq,
-            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
-            rows_p, n_ops_p, seqs_p,
+            self.head, self.head_vc, row_mat, seq_mat,
         )
-        self.n_ops[rows] = 0
+        self.n_ops[shards, rows] = 0
 
-    def read(self, rows, read_vcs) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
-        """Materialize a batch of keys at per-row read VCs.
+    def read_latest(
+        self, shards, rows, read_vcs
+    ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """Fast path: gather head states.  Returns (state fields [M, ...],
+        fresh [M]).  A row is fresh iff head_vc ≤ its read VC — then the
+        head IS the exact snapshot.  Stale rows must use :meth:`read`."""
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        read_vcs = np.asarray(read_vcs, np.int32)
+        row_mat, pos = self._route(shards, rows)
+        p, mm = row_mat.shape
+        vc_mat = np.zeros((p, mm, read_vcs.shape[-1]), np.int32)
+        vc_mat[pos[:, 0], pos[:, 1]] = read_vcs
+        row_gather = np.minimum(row_mat, self.n_rows - 1)
+        state, fresh = self._read_latest_fn(
+            self.head, self.head_vc, row_gather, vc_mat
+        )
+        s, j = pos[:, 0], pos[:, 1]
+        out = {f: np.asarray(x)[s, j] for f, x in state.items()}
+        return out, np.asarray(fresh)[s, j]
 
-        Returns host copies: (state fields [M, ...], n_applied [M],
+    def read(self, shards, rows, read_vcs) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """Materialize a flat batch of keys at per-key read VCs.
+
+        Returns host copies (state fields [M, ...], n_applied [M],
         complete [M]).  Incomplete rows need a log-replay fallback.
         """
+        shards = np.asarray(shards, np.int64)
         rows = np.asarray(rows, np.int64)
         read_vcs = np.asarray(read_vcs, np.int32)
         m = len(rows)
-        mb = _bucket(m, self.cfg.batch_buckets)
-        pad = mb - m
-        rows_p = np.concatenate([rows, np.full(pad, 0, np.int64)])
-        vcs_p = np.concatenate([read_vcs, np.zeros((pad,) + read_vcs.shape[1:], np.int32)])
-        n_ops_p = np.concatenate([self.n_ops[rows], np.zeros(pad, np.int32)])
-        state, applied, complete = self._read_fn()(
+        row_mat, pos = self._route(shards, rows)
+        p, mm = row_mat.shape
+        # clip padding rows for the gather path
+        row_gather = np.minimum(row_mat, self.n_rows - 1)
+        n_ops_mat = self.n_ops[np.arange(p)[:, None], row_gather]
+        n_ops_mat = np.where(row_mat < self.n_rows, n_ops_mat, 0)
+        vc_mat = np.zeros((p, mm, read_vcs.shape[-1]), np.int32)
+        vc_mat[pos[:, 0], pos[:, 1]] = read_vcs
+        state, applied, complete = self._read_fn(
             self.snap, self.snap_vc, self.snap_seq,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
-            rows_p, n_ops_p, vcs_p,
+            row_gather, n_ops_mat, vc_mat,
         )
-        state = {f: np.asarray(x[:m]) for f, x in state.items()}
-        return state, np.asarray(applied[:m]), np.asarray(complete[:m])
+        s, j = pos[:, 0], pos[:, 1]
+        out = {f: np.asarray(x)[s, j] for f, x in state.items()}
+        return out, np.asarray(applied)[s, j], np.asarray(complete)[s, j]

@@ -1,0 +1,8 @@
+from antidote_tpu.txn.manager import (
+    AbortError,
+    Transaction,
+    TransactionManager,
+)
+from antidote_tpu.txn.hooks import HookRegistry
+
+__all__ = ["AbortError", "Transaction", "TransactionManager", "HookRegistry"]

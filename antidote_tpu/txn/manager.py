@@ -1,0 +1,240 @@
+"""Transaction layer: snapshot transactions over the sharded store.
+
+The host-side rebuild of the reference's Cure/ClockSI protocol stack
+(``cure`` + ``clocksi_interactive_coord`` + ``clocksi_vnode``; SURVEY
+§2.2, §3.1-3.3), restructured for a single-writer-per-replica host
+runtime in front of batched device kernels:
+
+  * snapshot selection: txn snapshot VC = freshest local applied VC merged
+    with the client's causal clock (create_transaction_record,
+    /root/reference/src/clocksi_interactive_coord.erl:675-702).  Clocks are
+    logical per-DC commit counters, so the reference's physical-clock waits
+    (wait_for_clock / check_clock) vanish.
+  * reads: batched device materializer folds at the snapshot VC, with the
+    transaction's own pending writes overlaid on top (the analogue of
+    apply_tx_updates_to_snapshot → materialize_eager,
+    /root/reference/src/clocksi_interactive_coord.erl:882-894).
+  * updates: type-check against the CRDT registry, run pre-commit hooks,
+    generate downstream effects (reading current state when the type
+    requires it — clocksi_downstream:generate_downstream_op,
+    /root/reference/src/clocksi_downstream.erl:38-68), buffer in the
+    write-set.
+  * commit: first-committer-wins certification per key (the ETS
+    committed_tx check, /root/reference/src/clocksi_vnode.erl:588-632),
+    then a single commit-counter bump mints the commit VC and the effects
+    are applied to the device tables in commit order.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from antidote_tpu.clock import vector as vcm
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt import get_type, is_type
+from antidote_tpu.store.kv import BoundObject, Effect, KVStore
+from antidote_tpu.txn.hooks import HookRegistry
+
+Update = Tuple[Any, str, str, Tuple[str, Any]]  # (key, type_name, bucket, op)
+
+
+class AbortError(Exception):
+    """Transaction aborted (certification conflict or pre-commit hook)."""
+
+
+class Transaction:
+    _ids = itertools.count(1)
+
+    def __init__(self, snapshot_vc: np.ndarray, props: Optional[dict] = None):
+        self.txid = next(Transaction._ids)
+        self.snapshot_vc = np.asarray(snapshot_vc, np.int32)
+        self.props = dict(props or {})
+        self.writeset: List[Tuple[Effect, Tuple[str, Any]]] = []
+        self.active = True
+
+    def pending_for(self, key, bucket) -> List[Effect]:
+        return [e for e, _ in self.writeset if e.key == key and e.bucket == bucket]
+
+
+class TransactionManager:
+    """One per replica process — owns the commit stream for ``my_dc``."""
+
+    def __init__(self, store: KVStore, my_dc: int = 0, cert: bool = True):
+        self.store = store
+        self.cfg: AntidoteConfig = store.cfg
+        self.my_dc = my_dc
+        #: txn_cert app-env flag (/root/reference/src/antidote.app.src:31-35)
+        self.cert = cert
+        self.commit_counter = 0
+        #: (key, bucket) -> my-lane counter of its last local commit
+        self.committed_keys: Dict[Tuple[Any, str], int] = {}
+        self.hooks = HookRegistry()
+        #: called with (effects, commit_vc, origin) after every local commit
+        #: — the inter-DC egress seam (inter_dc_log_sender_vnode:send,
+        #: /root/reference/src/inter_dc_log_sender_vnode.erl:80-81)
+        self.commit_listeners: List = []
+        self.metrics = None  # wired by obs layer
+
+    # ------------------------------------------------------------------
+    # transaction lifecycle (antidote.erl API shapes)
+    # ------------------------------------------------------------------
+    def start_transaction(
+        self, clock: Optional[np.ndarray] = None, props: Optional[dict] = None
+    ) -> Transaction:
+        snap = self.store.dc_max_vc()
+        if clock is not None:
+            snap = np.maximum(snap, np.asarray(clock, np.int32))
+        return Transaction(snap, props)
+
+    def read_objects(self, objects: Sequence[BoundObject], txn: Transaction):
+        assert txn.active
+        states = self._read_states_with_overlay(objects, txn)
+        return [
+            get_type(t).value(states[i], self.store.blobs, self.cfg)
+            for i, (_, t, _) in enumerate(objects)
+        ]
+
+    def update_objects(self, updates: Sequence[Update], txn: Transaction) -> None:
+        assert txn.active
+        for key, type_name, bucket, op in updates:
+            if not is_type(type_name):
+                raise TypeError(f"unknown CRDT type {type_name!r}")
+            ty = get_type(type_name)
+            if not ty.is_operation(op):
+                raise TypeError(f"invalid operation {op!r} for {type_name}")
+            try:
+                key, type_name, op = self.hooks.execute_pre_commit_hook(
+                    key, type_name, bucket, op
+                )
+            except Exception as e:
+                txn.active = False
+                raise AbortError(f"pre-commit hook failed: {e}") from e
+            # re-validate the hook-transformed update: a misbehaving hook
+            # must abort, not generate malformed effects
+            if not is_type(type_name):
+                txn.active = False
+                raise AbortError(
+                    f"pre-commit hook produced unknown type {type_name!r}"
+                )
+            ty = get_type(type_name)
+            if not ty.is_operation(op):
+                txn.active = False
+                raise AbortError(
+                    f"pre-commit hook produced invalid op {op!r} for {type_name}"
+                )
+            state = None
+            if ty.require_state_downstream(op):
+                state = self._read_states_with_overlay(
+                    [(key, type_name, bucket)], txn
+                )[0]
+            for eff_a, eff_b, blob_refs in ty.downstream(
+                op, state, self.store.blobs, self.cfg
+            ):
+                txn.writeset.append(
+                    (Effect(key, type_name, bucket, eff_a, eff_b, blob_refs), op)
+                )
+
+    def commit_transaction(self, txn: Transaction) -> np.ndarray:
+        assert txn.active
+        txn.active = False
+        if not txn.writeset:
+            return txn.snapshot_vc.copy()
+        # certification: abort if any written key saw a commit after our
+        # snapshot (first-committer-wins, certification_check,
+        # /root/reference/src/clocksi_vnode.erl:588-632)
+        if self.cert:
+            snap_here = int(txn.snapshot_vc[self.my_dc])
+            for eff, _ in txn.writeset:
+                last = self.committed_keys.get((eff.key, eff.bucket), 0)
+                if last > snap_here:
+                    raise AbortError(
+                        f"certification conflict on key {eff.key!r}"
+                    )
+        self.commit_counter += 1
+        commit_vc = txn.snapshot_vc.copy()
+        commit_vc[self.my_dc] = self.commit_counter
+        effects = [e for e, _ in txn.writeset]
+        self.store.apply_effects(
+            effects, [commit_vc] * len(effects), [self.my_dc] * len(effects)
+        )
+        for eff, _ in txn.writeset:
+            self.committed_keys[(eff.key, eff.bucket)] = self.commit_counter
+        for listener in self.commit_listeners:
+            listener(effects, commit_vc, self.my_dc)
+        for eff, op in txn.writeset:
+            self.hooks.execute_post_commit_hook(
+                eff.key, eff.type_name, eff.bucket, op
+            )
+        return commit_vc
+
+    def abort_transaction(self, txn: Transaction) -> None:
+        txn.active = False
+        txn.writeset.clear()
+
+    # ------------------------------------------------------------------
+    # static transactions (cure.erl fast paths, :118-183)
+    # ------------------------------------------------------------------
+    def update_objects_static(
+        self, updates: Sequence[Update], clock: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        txn = self.start_transaction(clock)
+        try:
+            self.update_objects(updates, txn)
+        except Exception:
+            self.abort_transaction(txn)
+            raise
+        return self.commit_transaction(txn)
+
+    def read_objects_static(
+        self, objects: Sequence[BoundObject], clock: Optional[np.ndarray] = None
+    ):
+        txn = self.start_transaction(clock)
+        vals = self.read_objects(objects, txn)
+        return vals, txn.snapshot_vc
+
+    # ------------------------------------------------------------------
+    # remote ingestion (used by the inter-DC layer's causal gate)
+    # ------------------------------------------------------------------
+    def apply_remote(
+        self, effects: Sequence[Effect], commit_vc: np.ndarray, origin: int
+    ) -> None:
+        commit_vc = np.asarray(commit_vc, np.int32)
+        self.store.apply_effects(
+            effects, [commit_vc] * len(effects), [origin] * len(effects)
+        )
+
+    # ------------------------------------------------------------------
+    def _read_states_with_overlay(self, objects, txn):
+        states = self.store.read_states(objects, txn.snapshot_vc)
+        if not txn.writeset:
+            return states
+        # overlay pending writes (materialize_eager,
+        # /root/reference/src/clocksi_materializer.erl:272-274); a tentative
+        # commit VC one past the snapshot stamps uncommitted dots
+        tentative = txn.snapshot_vc.copy()
+        tentative[self.my_dc] = self.commit_counter + 1
+        import jax.numpy as jnp
+
+        tvc = jnp.asarray(tentative, jnp.int32)
+        origin = jnp.int32(self.my_dc)
+        for i, (key, type_name, bucket) in enumerate(objects):
+            pend = txn.pending_for(key, bucket)
+            if not pend:
+                continue
+            ty = get_type(type_name)
+            state = {f: jnp.asarray(x) for f, x in states[i].items()}
+            for eff in pend:
+                state = ty.apply(
+                    self.cfg,
+                    state,
+                    jnp.asarray(eff.eff_a, jnp.int64),
+                    jnp.asarray(eff.eff_b, jnp.int32),
+                    tvc,
+                    origin,
+                )
+            states[i] = jax.tree.map(np.asarray, state)
+        return states

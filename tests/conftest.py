@@ -1,18 +1,28 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is unavailable in CI; the sharding layer is validated
 on virtual CPU devices (the driver separately dry-runs multi-chip via
 __graft_entry__.dryrun_multichip).
+
+Note: the environment's sitecustomize imports jax at interpreter startup
+(TPU tunnel plugin), so env vars set here are too late — we use
+jax.config.update, which works after import as long as no backend has
+been initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,187 @@
+"""Transaction-layer semantics, mirroring the reference's singledc suites
+(clocksi_SUITE read-your-writes/isolation/concurrency, antidote_SUITE
+static+interactive API, commit_hooks_SUITE; SURVEY §4 tier-3)."""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api import AbortError, AntidoteNode
+
+
+@pytest.fixture
+def node(cfg):
+    return AntidoteNode(cfg)
+
+
+def test_static_update_then_read(node):
+    vc = node.update_objects([("k1", "counter_pn", "b", ("increment", 4))])
+    vals, _ = node.read_objects([("k1", "counter_pn", "b")], clock=vc)
+    assert vals == [4]
+
+
+def test_interactive_read_your_writes(node):
+    txn = node.start_transaction()
+    node.update_objects([("k", "counter_pn", "b", ("increment", 2))], txn)
+    assert node.read_objects([("k", "counter_pn", "b")], txn) == [2]
+    node.update_objects([("k", "counter_pn", "b", ("increment", 3))], txn)
+    assert node.read_objects([("k", "counter_pn", "b")], txn) == [5]
+    vc = node.commit_transaction(txn)
+    vals, _ = node.read_objects([("k", "counter_pn", "b")], clock=vc)
+    assert vals == [5]
+
+
+def test_read_your_writes_set(node):
+    txn = node.start_transaction()
+    node.update_objects([("s", "set_aw", "b", ("add", "x"))], txn)
+    assert node.read_objects([("s", "set_aw", "b")], txn) == [["x"]]
+    node.update_objects([("s", "set_aw", "b", ("remove", "x"))], txn)
+    assert node.read_objects([("s", "set_aw", "b")], txn) == [[]]
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("s", "set_aw", "b")])
+    assert vals == [[]]
+
+
+def test_snapshot_isolation_between_txns(node):
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    txn = node.start_transaction()
+    before = node.read_objects([("k", "counter_pn", "b")], txn)
+    # another (static) txn commits concurrently
+    node.update_objects([("k2", "counter_pn", "b", ("increment", 99))])
+    node.update_objects([("k", "counter_pn", "b", ("increment", 99))],
+                        clock=None)
+    # the open txn still sees its snapshot
+    after = node.read_objects([("k", "counter_pn", "b")], txn)
+    assert before == after == [1]
+    node.commit_transaction(txn)
+
+
+def test_abort_discards_writes(node):
+    txn = node.start_transaction()
+    node.update_objects([("k", "counter_pn", "b", ("increment", 7))], txn)
+    node.abort_transaction(txn)
+    vals, _ = node.read_objects([("k", "counter_pn", "b")])
+    assert vals == [0]
+
+
+def test_certification_conflict_aborts_second_txn(node):
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    t1 = node.start_transaction()
+    t2 = node.start_transaction()
+    node.update_objects([("k", "counter_pn", "b", ("increment", 10))], t1)
+    node.update_objects([("k", "counter_pn", "b", ("increment", 100))], t2)
+    node.commit_transaction(t1)
+    with pytest.raises(AbortError):
+        node.commit_transaction(t2)
+    vals, _ = node.read_objects([("k", "counter_pn", "b")])
+    assert vals == [11]
+
+
+def test_certification_disabled_allows_both(cfg):
+    node = AntidoteNode(cfg, cert=False)
+    t1 = node.start_transaction()
+    t2 = node.start_transaction()
+    node.update_objects([("k", "counter_pn", "b", ("increment", 10))], t1)
+    node.update_objects([("k", "counter_pn", "b", ("increment", 100))], t2)
+    node.commit_transaction(t1)
+    node.commit_transaction(t2)
+    vals, _ = node.read_objects([("k", "counter_pn", "b")])
+    assert vals == [110]
+
+
+def test_read_only_txn_commits_at_snapshot(node):
+    node.update_objects([("k", "counter_pn", "b", ("increment", 5))])
+    txn = node.start_transaction()
+    node.read_objects([("k", "counter_pn", "b")], txn)
+    vc = node.commit_transaction(txn)
+    assert (vc == txn.snapshot_vc).all()
+
+
+def test_causal_clock_threading(node):
+    vc1 = node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    vc2 = node.update_objects([("k", "counter_pn", "b", ("increment", 1))],
+                              clock=vc1)
+    assert vc2[node.dc_id] > vc1[node.dc_id]
+    vals, _ = node.read_objects([("k", "counter_pn", "b")], clock=vc2)
+    assert vals == [2]
+
+
+def test_type_check_rejects_bad_ops(node):
+    with pytest.raises(TypeError):
+        node.update_objects([("k", "counter_pn", "b", ("assign", 5))])
+    with pytest.raises(TypeError):
+        node.update_objects([("k", "nosuch_type", "b", ("increment", 1))])
+    # binding the same key to a different type fails
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    with pytest.raises(TypeError):
+        node.update_objects([("k", "set_aw", "b", ("add", "x"))])
+
+
+def test_pre_commit_hook_transforms_update(node):
+    def double(kto):
+        key, type_name, (kind, n) = kto
+        return key, type_name, (kind, n * 2)
+
+    node.register_pre_hook("hooked", double)
+    node.update_objects([("k", "counter_pn", "hooked", ("increment", 3))])
+    vals, _ = node.read_objects([("k", "counter_pn", "hooked")])
+    assert vals == [6]
+
+
+def test_pre_commit_hook_failure_aborts(node):
+    def boom(kto):
+        raise ValueError("nope")
+
+    node.register_pre_hook("hooked", boom)
+    with pytest.raises(AbortError):
+        node.update_objects([("k", "counter_pn", "hooked", ("increment", 3))])
+    vals, _ = node.read_objects([("k", "counter_pn", "hooked")])
+    assert vals == [0]
+
+
+def test_post_commit_hook_observes_commit(node):
+    seen = []
+    node.register_post_hook("hooked", lambda kto: seen.append(kto))
+    node.update_objects([("k", "counter_pn", "hooked", ("increment", 3))])
+    assert seen == [("k", "counter_pn", ("increment", 3))]
+
+
+def test_post_commit_hook_failure_nonfatal(node):
+    def boom(kto):
+        raise ValueError("nope")
+
+    node.register_post_hook("hooked", boom)
+    vc = node.update_objects([("k", "counter_pn", "hooked", ("increment", 3))])
+    vals, _ = node.read_objects([("k", "counter_pn", "hooked")], clock=vc)
+    assert vals == [3]
+
+
+def test_multi_key_multi_type_txn(node):
+    txn = node.start_transaction()
+    node.update_objects(
+        [
+            ("c", "counter_pn", "b", ("increment", 1)),
+            ("r", "register_lww", "b", ("assign", "v")),
+            ("s", "set_aw", "b", ("add_all", ["a", "b"])),
+            ("f", "flag_ew", "b", ("enable", None)),
+        ],
+        txn,
+    )
+    vc = node.commit_transaction(txn)
+    vals, _ = node.read_objects(
+        [
+            ("c", "counter_pn", "b"),
+            ("r", "register_lww", "b"),
+            ("s", "set_aw", "b"),
+            ("f", "flag_ew", "b"),
+        ],
+        clock=vc,
+    )
+    assert vals == [1, "v", ["a", "b"], True]
+
+
+def test_many_keys_across_shards(node):
+    updates = [(i, "counter_pn", "b", ("increment", i)) for i in range(40)]
+    vc = node.update_objects(updates)
+    objs = [(i, "counter_pn", "b") for i in range(40)]
+    vals, _ = node.read_objects(objs, clock=vc)
+    assert vals == [i for i in range(40)]

@@ -20,7 +20,7 @@ class Driver:
     def __init__(self, ty_name, cfg, dc=0):
         self.cfg = cfg
         self.ty = get_type(ty_name)
-        self.table = TypedTable(self.ty, cfg, n_rows=8)
+        self.table = TypedTable(self.ty, cfg, n_rows=8, n_shards=1)
         self.blobs = BlobStore()
         self.clock = np.zeros(cfg.max_dcs, np.int32)
 
@@ -38,15 +38,15 @@ class Driver:
                 self.clock[dc] += 1
                 cvc = self.clock.copy()
             self.table.append(
-                np.asarray([row]),
+                np.asarray([0]), np.asarray([row]),
                 a[None, :], b[None, :], cvc[None, :],
-                np.asarray([dc], np.int32), self.clock,
+                np.asarray([dc], np.int32),
             )
         return self.clock.copy()
 
     def read(self, row, at_vc):
         state, _, complete = self.table.read(
-            np.asarray([row]), np.asarray(at_vc, np.int32)[None, :]
+            np.asarray([0]), np.asarray([row]), np.asarray(at_vc, np.int32)[None, :]
         )
         one = {f: x[0] for f, x in state.items()}
         return one, bool(complete[0])
@@ -88,7 +88,7 @@ def test_gc_fold_and_versions(cfg):
         d.commit(0, ("increment", 1))
     assert d.value(0, d.clock) == 20
     # ring was folded at least once
-    assert d.table.n_ops[0] < 20
+    assert d.table.n_ops[0, 0] < 20
     # older reads within retained coverage still work
     state, complete = d.read(0, d.clock)
     assert complete
@@ -102,8 +102,8 @@ def test_incomplete_read_detection(cfg):
     _, complete = d.read(0, [1, 0, 0])
     if complete:
         # only acceptable if a retained version is exactly dominated
-        seqs = np.asarray(d.table.snap_seq[0])
-        vcs = np.asarray(d.table.snap_vc[0])
+        seqs = np.asarray(d.table.snap_seq[0, 0])
+        vcs = np.asarray(d.table.snap_vc[0, 0])
         ok = any(
             s > 0 and (v <= np.asarray([1, 0, 0])).all()
             for s, v in zip(seqs, vcs)
@@ -138,10 +138,10 @@ def test_register_mv_concurrent_assigns_coexist(cfg):
     e2 = d.ty.downstream(("assign", "r"), state, d.blobs, d.cfg)[0]
     vc1 = np.asarray([2, 0, 0], np.int32)
     vc2 = np.asarray([1, 1, 0], np.int32)
-    d.table.append(np.asarray([0]), e1[0][None], e1[1][None], vc1[None],
-                   np.asarray([0], np.int32), np.asarray([2, 1, 0], np.int32))
-    d.table.append(np.asarray([0]), e2[0][None], e2[1][None], vc2[None],
-                   np.asarray([1], np.int32), np.asarray([2, 1, 0], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), e1[0][None], e1[1][None], vc1[None],
+                   np.asarray([0], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), e2[0][None], e2[1][None], vc2[None],
+                   np.asarray([1], np.int32))
     assert d.value(0, [2, 1, 0]) == ["l", "r"]
     # sequential assign observing both collapses to one value
     d.clock = np.asarray([2, 1, 0], np.int32)
@@ -169,10 +169,10 @@ def test_set_aw_concurrent_add_wins(cfg):
     ad = d.ty.downstream(("add", "x"), None, d.blobs, d.cfg)[0]
     vc_rm = np.asarray([1, 1, 0], np.int32)
     vc_ad = np.asarray([1, 0, 1], np.int32)
-    d.table.append(np.asarray([0]), rm[0][None], rm[1][None], vc_rm[None],
-                   np.asarray([1], np.int32), np.asarray([1, 1, 1], np.int32))
-    d.table.append(np.asarray([0]), ad[0][None], ad[1][None], vc_ad[None],
-                   np.asarray([2], np.int32), np.asarray([1, 1, 1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), rm[0][None], rm[1][None], vc_rm[None],
+                   np.asarray([1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), ad[0][None], ad[1][None], vc_ad[None],
+                   np.asarray([2], np.int32))
     # add wins: x present when both are visible
     assert d.value(0, [1, 1, 1]) == ["x"]
     # remove-only view: x absent
@@ -195,10 +195,10 @@ def test_set_rw_concurrent_remove_wins(cfg):
     rm = d.ty.downstream(("remove", "x"), state, d.blobs, d.cfg)[0]
     vc_ad = np.asarray([1, 1, 0], np.int32)
     vc_rm = np.asarray([1, 0, 1], np.int32)
-    d.table.append(np.asarray([0]), ad[0][None], ad[1][None], vc_ad[None],
-                   np.asarray([1], np.int32), np.asarray([1, 1, 1], np.int32))
-    d.table.append(np.asarray([0]), rm[0][None], rm[1][None], vc_rm[None],
-                   np.asarray([2], np.int32), np.asarray([1, 1, 1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), ad[0][None], ad[1][None], vc_ad[None],
+                   np.asarray([1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), rm[0][None], rm[1][None], vc_rm[None],
+                   np.asarray([2], np.int32))
     assert d.value(0, [1, 1, 1]) == []
 
 
@@ -232,10 +232,10 @@ def test_flag_ew(cfg):
     di = d.ty.downstream(("disable", None), state, d.blobs, d.cfg)[0]
     vc_en = np.asarray([d.clock[0], 1, 0], np.int32)
     vc_di = np.asarray([d.clock[0], 0, 1], np.int32)
-    d.table.append(np.asarray([0]), en[0][None], en[1][None], vc_en[None],
-                   np.asarray([1], np.int32), np.maximum(vc_en, vc_di))
-    d.table.append(np.asarray([0]), di[0][None], di[1][None], vc_di[None],
-                   np.asarray([2], np.int32), np.maximum(vc_en, vc_di))
+    d.table.append(np.asarray([0]), np.asarray([0]), en[0][None], en[1][None], vc_en[None],
+                   np.asarray([1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), di[0][None], di[1][None], vc_di[None],
+                   np.asarray([2], np.int32))
     v = d.value(0, np.maximum(vc_en, vc_di))
     assert v is True
 
@@ -250,10 +250,10 @@ def test_flag_dw(cfg):
     di = d.ty.downstream(("disable", None), state, d.blobs, d.cfg)[0]
     vc_en = np.asarray([d.clock[0], 1, 0], np.int32)
     vc_di = np.asarray([d.clock[0], 0, 1], np.int32)
-    d.table.append(np.asarray([0]), en[0][None], en[1][None], vc_en[None],
-                   np.asarray([1], np.int32), np.maximum(vc_en, vc_di))
-    d.table.append(np.asarray([0]), di[0][None], di[1][None], vc_di[None],
-                   np.asarray([2], np.int32), np.maximum(vc_en, vc_di))
+    d.table.append(np.asarray([0]), np.asarray([0]), en[0][None], en[1][None], vc_en[None],
+                   np.asarray([1], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), di[0][None], di[1][None], vc_di[None],
+                   np.asarray([2], np.int32))
     assert d.value(0, np.maximum(vc_en, vc_di)) is False
 
 
@@ -277,10 +277,10 @@ def test_counter_fat_concurrent_increment_survives_reset(cfg):
     inc = d.ty.downstream(("increment", 7), None, d.blobs, d.cfg)[0]
     vc_rs = np.asarray([2, 0, 0], np.int32)
     vc_inc = np.asarray([1, 1, 0], np.int32)
-    d.table.append(np.asarray([0]), rs[0][None], rs[1][None], vc_rs[None],
-                   np.asarray([0], np.int32), np.asarray([2, 1, 0], np.int32))
-    d.table.append(np.asarray([0]), inc[0][None], inc[1][None], vc_inc[None],
-                   np.asarray([1], np.int32), np.asarray([2, 1, 0], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), rs[0][None], rs[1][None], vc_rs[None],
+                   np.asarray([0], np.int32))
+    d.table.append(np.asarray([0]), np.asarray([0]), inc[0][None], inc[1][None], vc_inc[None],
+                   np.asarray([1], np.int32))
     assert d.value(0, [2, 1, 0]) == 7
 
 
@@ -303,6 +303,36 @@ def test_batched_read_many_keys(cfg):
         d.commit(row, ("increment", row + 1))
     rows = np.arange(6)
     vcs = np.broadcast_to(d.clock, (6, cfg.max_dcs))
-    state, applied, complete = d.table.read(rows, vcs)
+    state, applied, complete = d.table.read(np.zeros(6, np.int64), rows, vcs)
     assert complete.all()
     assert list(state["cnt"]) == [1, 2, 3, 4, 5, 6]
+
+
+def test_read_between_versions_flagged_incomplete(cfg):
+    # regression: ops folded into a newer snapshot version must not be
+    # silently missing from a read served off an older version
+    d = Driver("counter_pn", cfg)
+    for i in range(20):
+        d.commit(0, ("increment", 1))
+    # two retained versions exist at [8,..] and [16,..]; ring holds 17-20
+    seqs = np.asarray(d.table.snap_seq[0, 0])
+    assert (seqs > 0).sum() >= 2
+    vcs = np.asarray(d.table.snap_vc[0, 0])
+    older = vcs[np.argsort(seqs)][-2]  # older retained version's VC
+    probe = older.copy()
+    probe[0] += 2  # between the two versions
+    state, complete = d.read(0, probe)
+    assert not complete  # must demand log-replay, not serve stale 'older'
+
+
+def test_set_slot_overflow_warns(cfg):
+    d = Driver("set_aw", cfg)
+    for i in range(cfg.set_slots + 3):
+        d.commit(0, ("add", f"e{i}"))
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        v = d.value(0, d.clock)
+    assert len(v) == cfg.set_slots
+    assert any("set_slots exhausted" in str(r.message) for r in rec)
