@@ -37,11 +37,43 @@ class AntidoteNode:
         dc_id: int = 0,
         sharding=None,
         cert: bool = True,
+        log_dir: Optional[str] = None,
+        recover: bool = False,
     ):
         self.cfg = cfg or AntidoteConfig()
         self.dc_id = dc_id
-        self.store = KVStore(self.cfg, sharding=sharding)
+        log = None
+        if log_dir is not None and self.cfg.enable_logging:
+            import glob
+            import os
+
+            from antidote_tpu.log import LogManager
+
+            has_data = any(
+                os.path.getsize(p) > 0
+                for p in glob.glob(os.path.join(log_dir, "shard_*.wal"))
+            )
+            if has_data and not recover:
+                # appending to an existing log with fresh counters would
+                # mint duplicate (commit counter, origin) dots — corruption
+                raise RuntimeError(
+                    f"log_dir {log_dir!r} contains existing WAL data; pass "
+                    "recover=True (or point at an empty directory)"
+                )
+            log = LogManager(self.cfg, log_dir)
+        elif recover:
+            raise RuntimeError(
+                "recover=True requires log_dir and cfg.enable_logging"
+            )
+        self.store = KVStore(self.cfg, sharding=sharding, log=log)
         self.txm = TransactionManager(self.store, my_dc=dc_id, cert=cert)
+        if recover and log is not None:
+            # node restart: replay the durable log into the device tables
+            # and rebuild the certification table + commit counter
+            # (check_node_restart, /root/reference/src/inter_dc_manager.erl:156-206)
+            last = self.store.recover(track_origin=dc_id)
+            self.txm.committed_keys.update(last)
+            self.txm.commit_counter = int(self.store.dc_max_vc()[dc_id])
 
     # --- transactions (antidote.erl:36-54) -----------------------------
     def start_transaction(self, clock=None, props=None) -> Transaction:

@@ -15,6 +15,7 @@ from antidote_tpu.crdt.blob import BlobStore
 from antidote_tpu.crdt.counters import CounterB, CounterFat, CounterPN
 from antidote_tpu.crdt.flags import FlagDW, FlagEW
 from antidote_tpu.crdt.registers import RegisterLWW, RegisterMV
+from antidote_tpu.crdt.rga import RGA
 from antidote_tpu.crdt.sets import SetAW, SetGO, SetRW
 
 TYPES: Dict[str, CRDTType] = {}
@@ -29,6 +30,8 @@ def register_type(t: CRDTType) -> CRDTType:
     return t
 
 
+from antidote_tpu.crdt.maps import MapGO, MapRR  # noqa: E402
+
 for _t in (
     CounterPN(),
     CounterFat(),
@@ -40,6 +43,9 @@ for _t in (
     SetGO(),
     FlagEW(),
     FlagDW(),
+    RGA(),
+    MapGO(),
+    MapRR(),
 ):
     register_type(_t)
 

@@ -1,0 +1,150 @@
+"""RGA — replicated growable array (sequence CRDT).
+
+The reference capability ``antidote_crdt_rga`` (BASELINE.json config 5): a
+sequence with insert-at-index / delete, converging under concurrent edits
+via the RGA rule — an insert lands immediately right of its causal left
+origin, skipping over any sibling elements whose insertion dot is larger.
+
+Dense layout per key (S = cfg.rga_slots), kept in list order:
+
+  uid   i64[S]  insertion dot = (commit counter at origin << 8) | origin
+  elem  i64[S]  value handle (0 = empty slot)
+  tomb  i32[S]  1 = deleted (tombstones keep order; GC'able once stable)
+  ovf   i32     inserts dropped for lack of slots
+
+Insert is one vectorized shift (no per-element loop): find the insert
+position p (first slot right of the origin whose uid is smaller than the
+new dot, or empty), then ``new[i] = old[i-1] for i > p``.
+
+Downstream maps a client index (over visible elements) to the origin uid
+(requires state).  Ops: ("insert", (index, value)), ("delete", index),
+("add_right", (origin_uid, value)) for replay.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.crdt.base import CRDTType, Effect
+from antidote_tpu.crdt.sets import _warn_overflow
+
+_INSERT, _DELETE = 0, 1
+_HEAD_UID = 0  # insert at the very front
+
+
+class RGA(CRDTType):
+    name = "rga"
+    type_id = 11
+
+    def eff_a_width(self, cfg):
+        return 2  # [elem_handle | target_uid, origin_uid]
+
+    def state_spec(self, cfg):
+        s = cfg.rga_slots
+        return {
+            "uid": ((s,), jnp.int64),
+            "elem": ((s,), jnp.int64),
+            "tomb": ((s,), jnp.int32),
+            "ovf": ((), jnp.int32),
+        }
+
+    def is_operation(self, op):
+        kind = op[0]
+        if kind == "insert":
+            return isinstance(op[1], tuple) and len(op[1]) == 2
+        if kind == "delete":
+            return isinstance(op[1], int)
+        return kind == "add_right"
+
+    def require_state_downstream(self, op):
+        return op[0] in ("insert", "delete")
+
+    def _visible_positions(self, state):
+        uid = np.asarray(state["uid"])
+        tomb = np.asarray(state["tomb"])
+        occupied = uid != 0
+        return np.nonzero(occupied & (tomb == 0))[0], uid
+
+    def downstream(self, op, state, blobs, cfg) -> List[Effect]:
+        kind = op[0]
+        b = np.zeros((self.eff_b_width(cfg),), np.int32)
+        a = np.zeros((2,), np.int64)
+        if kind == "delete":
+            visible, uid = self._visible_positions(state)
+            idx = op[1]
+            if idx < 0 or idx >= len(visible):
+                raise IndexError(f"rga delete index {idx} out of range")
+            b[0] = _DELETE
+            a[0] = uid[visible[idx]]
+            return [(a, b, [])]
+        if kind == "insert":
+            idx, value = op[1]
+            visible, uid = self._visible_positions(state)
+            if idx < 0 or idx > len(visible):
+                raise IndexError(f"rga insert index {idx} out of range")
+            origin_uid = _HEAD_UID if idx == 0 else int(uid[visible[idx - 1]])
+        else:  # add_right: explicit origin uid (replay/wire form)
+            origin_uid, value = op[1]
+        h = blobs.intern(value)
+        b[0] = _INSERT
+        a[0] = h
+        a[1] = origin_uid
+        return [(a, b, [(h, blobs.bytes_of(h))])]
+
+    def value(self, state, blobs, cfg):
+        _warn_overflow(self.name, state)
+        visible, _ = self._visible_positions(state)
+        elems = np.asarray(state["elem"])
+        return [blobs.resolve(int(elems[i])) for i in visible]
+
+    def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
+        s = cfg.rga_slots
+        uid, elem, tomb = state["uid"], state["elem"], state["tomb"]
+        kind = eff_b[0]
+        pos = jnp.arange(s)
+
+        # ---- delete: tombstone the target uid
+        target = eff_a[0]
+        hit = uid == target
+        tomb_d = jnp.where(jnp.any(hit), tomb.at[jnp.argmax(hit)].set(1), tomb)
+
+        # ---- insert
+        h = eff_a[0]
+        origin_uid = eff_a[1]
+        new_uid = (
+            commit_vc[origin_dc].astype(jnp.int64) << 8
+        ) | origin_dc.astype(jnp.int64)
+        occupied = uid != 0
+        o_hit = uid == origin_uid
+        # position of origin (-1 = head); if the origin was never inserted
+        # (should not happen under causal delivery) drop the op
+        origin_ok = (origin_uid == _HEAD_UID) | jnp.any(o_hit)
+        idx_origin = jnp.where(
+            origin_uid == _HEAD_UID, -1, jnp.argmax(o_hit).astype(jnp.int64)
+        )
+        # RGA rule: first slot right of origin whose uid < new dot (or empty)
+        candidate = (pos > idx_origin) & ((uid < new_uid) | ~occupied)
+        has_pos = jnp.any(candidate)
+        p = jnp.argmax(candidate)
+        has_room = ~occupied[s - 1]  # last slot free ⇒ shift cannot drop data
+        can = origin_ok & has_pos & has_room
+
+        def shifted(arr, newval):
+            prev = jnp.roll(arr, 1)
+            return jnp.where(pos < p, arr, jnp.where(pos == p, newval, prev))
+
+        uid_i = jnp.where(can, shifted(uid, new_uid), uid)
+        elem_i = jnp.where(can, shifted(elem, h), elem)
+        tomb_i = jnp.where(can, shifted(tomb, jnp.int32(0)), tomb)
+        dropped = (kind == _INSERT) & ~can
+
+        is_del = kind == _DELETE
+        return {
+            "uid": jnp.where(is_del, uid, uid_i),
+            "elem": jnp.where(is_del, elem, elem_i),
+            "tomb": jnp.where(is_del, tomb_d, tomb_i),
+            "ovf": state["ovf"] + dropped.astype(jnp.int32),
+        }

@@ -1,0 +1,5 @@
+from antidote_tpu.interdc.messages import Descriptor, TxnMessage
+from antidote_tpu.interdc.replica import DCReplica
+from antidote_tpu.interdc.transport import LoopbackHub
+
+__all__ = ["Descriptor", "TxnMessage", "DCReplica", "LoopbackHub"]

@@ -1,0 +1,75 @@
+"""Inter-DC transports.
+
+Two-tier like the reference (SURVEY §5): a pub/sub stream for the txn feed
+(ZeroMQ PUB/SUB in the reference, /root/reference/src/inter_dc_pub.erl /
+inter_dc_sub.erl) and a request/response channel for log catch-up queries
+(ZeroMQ REQ/XREP, /root/reference/src/inter_dc_query.erl).
+
+``LoopbackHub`` is the in-process deterministic transport used by tests —
+the analogue of the reference's many-BEAM-nodes-on-one-box Common Test
+topology (/root/reference/test/utils/test_utils.erl:110-165).  Messages
+enqueue; ``pump()`` drains until quiescent, so causality/buffering logic is
+exercised deterministically.  It can also drop messages on demand to test
+the gap/catch-up path.  A TCP transport drives the same replica callbacks
+over sockets (see server.py) for real multi-process deployments.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Tuple
+
+
+class LoopbackHub:
+    """Deterministic in-process pub/sub + query fabric between replicas."""
+
+    def __init__(self):
+        #: dc_id -> subscriber callback (bytes -> None)
+        self.subscribers: Dict[int, List[Callable[[bytes], None]]] = {}
+        #: dc_id -> log-query handler (shard, origin, from_opid) -> [bytes]
+        self.query_handlers: Dict[int, Callable] = {}
+        self.queues: collections.deque = collections.deque()
+        #: (from_dc, to_dc) pairs whose next N messages are dropped
+        self.drop: Dict[Tuple[int, int], int] = {}
+        self.delivered = 0
+        self.dropped = 0
+
+    def register(self, dc_id: int, on_message: Callable[[bytes], None],
+                 query_handler: Callable) -> None:
+        self.subscribers.setdefault(dc_id, [])
+        self.query_handlers[dc_id] = query_handler
+
+    def subscribe(self, subscriber_dc: int, publisher_dc: int,
+                  on_message: Callable[[bytes], None]) -> None:
+        self.subscribers.setdefault(publisher_dc, []).append(
+            (subscriber_dc, on_message)
+        )
+
+    def publish(self, from_dc: int, data: bytes) -> None:
+        for to_dc, cb in self.subscribers.get(from_dc, []):
+            key = (from_dc, to_dc)
+            if self.drop.get(key, 0) > 0:
+                self.drop[key] -= 1
+                self.dropped += 1
+                continue
+            self.queues.append((cb, data))
+
+    def query_log(self, target_dc: int, shard: int, origin: int,
+                  from_opid: int) -> List[bytes]:
+        """Synchronous catch-up query against a remote DC's log reader
+        (?LOG_READ_MSG, /root/reference/src/inter_dc_query_response.erl:97-126)."""
+        return self.query_handlers[target_dc](shard, origin, from_opid)
+
+    def drop_next(self, from_dc: int, to_dc: int, n: int = 1) -> None:
+        """Fault injection: lose the next n messages on a link."""
+        self.drop[(from_dc, to_dc)] = self.drop.get((from_dc, to_dc), 0) + n
+
+    def pump(self, max_rounds: int = 10_000) -> int:
+        """Deliver queued messages until quiescent; returns count."""
+        n = 0
+        while self.queues and n < max_rounds:
+            cb, data = self.queues.popleft()
+            cb(data)
+            self.delivered += 1
+            n += 1
+        return n

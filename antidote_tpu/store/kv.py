@@ -25,6 +25,14 @@ from antidote_tpu.store.typed_table import TypedTable
 BoundObject = Tuple[Any, str, str]  # (key, type_name, bucket)
 
 
+def freeze_key(key: Any) -> Any:
+    """Normalize a key after wire/log deserialization: msgpack returns
+    tuples as lists, but directory keys must be hashable."""
+    if isinstance(key, list):
+        return tuple(freeze_key(k) for k in key)
+    return key
+
+
 def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
     """Key→shard map.  Integer keys map directly (mod n_shards), other keys
     hash — mirroring log_utilities:get_key_partition
@@ -53,12 +61,15 @@ class Effect:
 
 
 class KVStore:
-    def __init__(self, cfg: AntidoteConfig, sharding=None):
+    def __init__(self, cfg: AntidoteConfig, sharding=None, log=None):
         self.cfg = cfg
         self.sharding = sharding
         self.tables: Dict[str, TypedTable] = {}
         self.directory: Dict[Tuple[Any, str], Tuple[str, int, int]] = {}
         self.blobs = BlobStore()
+        #: optional LogManager — when set, effects are logged (with blob
+        #: payloads) before the device tables observe them
+        self.log = log
         # per-shard applied VC (partition clock) — min over shards is the
         # DC's stable snapshot (stable_time_functions:get_min_time,
         # /root/reference/src/stable_time_functions.erl:51-85)
@@ -113,10 +124,19 @@ class KVStore:
             _, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
             for h, data in eff.blob_refs:
                 self.blobs.intern_bytes(h, data)
+            if self.log is not None:
+                # durability first: log (with blob payloads) before apply
+                self.log.log_effect(
+                    shard, eff.key, eff.type_name, eff.bucket,
+                    eff.eff_a, eff.eff_b, commit_vcs[i], origins[i],
+                    eff.blob_refs,
+                )
             by_type.setdefault(eff.type_name, []).append(
                 (shard, row, eff.eff_a, eff.eff_b, commit_vcs[i], origins[i])
             )
             touched.append((shard, np.asarray(commit_vcs[i], np.int32)))
+        if self.log is not None and touched:
+            self.log.commit_barrier([s for s, _ in touched])
         for type_name, items in by_type.items():
             t = self.table(type_name)
             t.append(
@@ -156,15 +176,27 @@ class KVStore:
                 # stale rows: versioned snapshot + ring fold at the read VC
                 stale = ~fresh
                 s2, _, complete = t.read(shards[stale], rows[stale], vcs[stale])
-                if not complete.all():
-                    # log-replay fallback not yet wired: surface loudly
-                    raise RuntimeError(
-                        f"incomplete read for type {type_name}: read VC below "
-                        "retained snapshot coverage"
-                    )
-                idxs = np.nonzero(stale)[0]
+                idxs = np.nonzero(stale)[0]  # positions within this type batch
                 for f in state:
                     state[f][idxs] = s2[f]
+                if not complete.all():
+                    # below retained device coverage: host log-replay
+                    # fallback (get_from_snapshot_log,
+                    # /root/reference/src/materializer_vnode.erl:415-419);
+                    # group by shard so each shard's WAL is scanned once
+                    incomplete = [int(idxs[j]) for j in np.nonzero(~complete)[0]]
+                    by_shard: Dict[int, list] = {}
+                    for j in incomplete:
+                        gi = items[j][0]  # global object index
+                        key, tname, bucket = objects[gi]
+                        by_shard.setdefault(items[j][1], []).append(
+                            (j, key, tname, bucket)
+                        )
+                    for shard, wants in by_shard.items():
+                        reps = self._replay_read_many(shard, wants, read_vc)
+                        for j, rep in reps.items():
+                            for f in state:
+                                state[f][j] = rep[f]
             for j, (i, _, _) in enumerate(items):
                 out[i] = {f: x[j] for f, x in state.items()}
         return out  # type: ignore[return-value]
@@ -181,6 +213,93 @@ class KVStore:
         ]
 
     # ------------------------------------------------------------------
+    def _replay_read_many(self, shard: int, wants, read_vc):
+        """Rebuild several keys' states at ``read_vc`` from one scan of the
+        shard's durable log.  ``wants`` = [(result_idx, key, type, bucket)].
+        """
+        if self.log is None:
+            raise RuntimeError(
+                f"incomplete read for {[w[1] for w in wants]!r} and no log "
+                "attached: read VC below retained snapshot coverage"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        read_vc = np.asarray(read_vc, np.int32)
+        states = {}
+        index = {}
+        for j, key, tname, bucket in wants:
+            ty = get_type(tname)
+            spec = ty.state_spec(self.cfg)
+            states[j] = {
+                f: jnp.zeros(shape, dtype) for f, (shape, dtype) in spec.items()
+            }
+            index[(key, bucket)] = (j, ty)
+        for rec in self.log.replay_shard(shard):
+            hit = index.get((freeze_key(rec["k"]), rec["b"]))
+            if hit is None:
+                continue
+            j, ty = hit
+            vc = np.asarray(rec["vc"], np.int32)
+            if not (vc <= read_vc).all():
+                continue
+            states[j] = ty.apply(
+                self.cfg, states[j],
+                jnp.asarray(np.frombuffer(rec["a"], np.int64)),
+                jnp.asarray(np.frombuffer(rec["eb"], np.int32)),
+                jnp.asarray(vc), jnp.int32(rec["o"]),
+            )
+        return {j: jax.tree.map(np.asarray, s) for j, s in states.items()}
+
+    def recover(self, track_origin: int | None = None) -> Dict:
+        """Rebuild tables, clocks, blobs and op-id chains from the log
+        (boot-time recover_from_log,
+        /root/reference/src/materializer_vnode.erl:192-216 and op-id scan,
+        /root/reference/src/logging_vnode.erl:595-643).
+
+        When ``track_origin`` is given, returns {(key, bucket): last commit
+        counter at that origin} — used to rebuild the certification table.
+        """
+        assert self.log is not None
+        last_commit: Dict = {}
+        for shard in range(self.cfg.n_shards):
+            batch: List[Effect] = []
+            vcs: List[np.ndarray] = []
+            orgs: List[int] = []
+            for rec in self.log.replay_shard(shard):
+                for h, data in rec.get("bl", ()):
+                    self.blobs.intern_bytes(h, data)
+                    # already durable: don't re-log these payloads later
+                    self.log._blob_seen[shard].add(h)
+                ty = get_type(rec["t"])
+                batch.append(Effect(
+                    freeze_key(rec["k"]), rec["t"], rec["b"],
+                    np.frombuffer(rec["a"], np.int64),
+                    np.frombuffer(rec["eb"], np.int32),
+                ))
+                vcs.append(np.asarray(rec["vc"], np.int32))
+                orgs.append(int(rec["o"]))
+                self.log.op_ids[shard, rec["o"]] = max(
+                    self.log.op_ids[shard, rec["o"]], rec["id"]
+                )
+                if track_origin is not None and rec["o"] == track_origin:
+                    last_commit[(freeze_key(rec["k"]), rec["b"])] = int(
+                        rec["vc"][track_origin]
+                    )
+                if len(batch) >= 4096:
+                    self._apply_recovered(batch, vcs, orgs)
+                    batch, vcs, orgs = [], [], []
+            if batch:
+                self._apply_recovered(batch, vcs, orgs)
+        return last_commit
+
+    def _apply_recovered(self, batch, vcs, orgs):
+        log, self.log = self.log, None  # don't re-log during replay
+        try:
+            self.apply_effects(batch, vcs, orgs)
+        finally:
+            self.log = log
+
     def stable_vc(self) -> np.ndarray:
         """DC-wide stable snapshot = entry-wise min of per-shard clocks."""
         return self.applied_vc.min(axis=0)

@@ -77,35 +77,94 @@ class TransactionManager:
         #: — the inter-DC egress seam (inter_dc_log_sender_vnode:send,
         #: /root/reference/src/inter_dc_log_sender_vnode.erl:80-81)
         self.commit_listeners: List = []
+        #: called while waiting for the stable snapshot to reach a client
+        #: clock (wait_for_clock,
+        #: /root/reference/src/clocksi_interactive_coord.erl:915-926);
+        #: the inter-DC layer points this at its message pump
+        self.on_clock_wait = lambda: None
         self.metrics = None  # wired by obs layer
 
     # ------------------------------------------------------------------
     # transaction lifecycle (antidote.erl API shapes)
     # ------------------------------------------------------------------
+    def _snapshot_vc(self) -> np.ndarray:
+        """Txn snapshot: remote lanes from the DC stable snapshot (safe —
+        every shard has applied at least this much), own lane from the
+        commit counter (local commits apply synchronously)."""
+        snap = self.store.stable_vc().copy()
+        snap[self.my_dc] = self.commit_counter
+        return snap
+
     def start_transaction(
         self, clock: Optional[np.ndarray] = None, props: Optional[dict] = None
     ) -> Transaction:
-        snap = self.store.dc_max_vc()
+        snap = self._snapshot_vc()
         if clock is not None:
-            snap = np.maximum(snap, np.asarray(clock, np.int32))
+            clock = np.asarray(clock, np.int32)
+            mask = np.arange(len(snap)) != self.my_dc
+            for _ in range(10_000):
+                if (clock[mask] <= snap[mask]).all():
+                    break
+                self.on_clock_wait()
+                snap = self._snapshot_vc()
+            else:
+                raise TimeoutError(
+                    f"stable snapshot {snap} never reached client clock "
+                    f"{clock}"
+                )
+            snap = np.maximum(snap, clock)
         return Transaction(snap, props)
 
     def read_objects(self, objects: Sequence[BoundObject], txn: Transaction):
         assert txn.active
-        states = self._read_states_with_overlay(objects, txn)
-        return [
-            get_type(t).value(states[i], self.store.blobs, self.cfg)
-            for i, (_, t, _) in enumerate(objects)
-        ]
+        out: List[Any] = [None] * len(objects)
+        plain = []
+        for i, (key, t, bucket) in enumerate(objects):
+            if is_type(t) and getattr(get_type(t), "composite", False):
+                out[i] = self._read_map(key, t, bucket, txn)
+            else:
+                plain.append(i)
+        if plain:
+            objs = [objects[i] for i in plain]
+            states = self._read_states_with_overlay(objs, txn)
+            for j, i in enumerate(plain):
+                _, t, _ = objects[i]
+                out[i] = get_type(t).value(states[j], self.store.blobs, self.cfg)
+        return out
+
+    def _read_map(self, key, map_type: str, bucket: str, txn: Transaction):
+        """Assemble a composite map value: membership + nested reads
+        (recursion handles nested maps)."""
+        from antidote_tpu.crdt import maps as maps_mod
+
+        memb = self.read_objects(
+            [(maps_mod.member_key(key), maps_mod.MAP_MEMBERSHIP[map_type],
+              bucket)], txn
+        )[0]
+        fields = [tuple(x) for x in memb]
+        if not fields:
+            return {}
+        nested = self.read_objects(
+            [(maps_mod.field_key(key, f, ft), ft, bucket) for f, ft in fields],
+            txn,
+        )
+        return {
+            (f, ft): v for (f, ft), v in zip(fields, nested)
+        }
 
     def update_objects(self, updates: Sequence[Update], txn: Transaction) -> None:
         assert txn.active
-        for key, type_name, bucket, op in updates:
-            if not is_type(type_name):
-                raise TypeError(f"unknown CRDT type {type_name!r}")
-            ty = get_type(type_name)
-            if not ty.is_operation(op):
-                raise TypeError(f"invalid operation {op!r} for {type_name}")
+        for u in updates:
+            self._apply_update(u, txn, run_hooks=True)
+
+    def _apply_update(self, update, txn: Transaction, run_hooks: bool = False) -> None:
+        key, type_name, bucket, op = update
+        if not is_type(type_name):
+            raise TypeError(f"unknown CRDT type {type_name!r}")
+        ty = get_type(type_name)
+        if not ty.is_operation(op):
+            raise TypeError(f"invalid operation {op!r} for {type_name}")
+        if run_hooks:
             try:
                 key, type_name, op = self.hooks.execute_pre_commit_hook(
                     key, type_name, bucket, op
@@ -126,17 +185,30 @@ class TransactionManager:
                 raise AbortError(
                     f"pre-commit hook produced invalid op {op!r} for {type_name}"
                 )
-            state = None
-            if ty.require_state_downstream(op):
-                state = self._read_states_with_overlay(
-                    [(key, type_name, bucket)], txn
-                )[0]
-            for eff_a, eff_b, blob_refs in ty.downstream(
-                op, state, self.store.blobs, self.cfg
+        if getattr(ty, "composite", False):
+            # maps expand into membership + nested-field updates; children
+            # skip bucket hooks (they already ran on the map op above)
+            from antidote_tpu.crdt import maps as maps_mod
+
+            def read_field_value(fk, ft):
+                return self.read_objects([(fk, ft, bucket)], txn)[0]
+
+            for sub in maps_mod.expand_update(
+                key, type_name, bucket, op, read_field_value
             ):
-                txn.writeset.append(
-                    (Effect(key, type_name, bucket, eff_a, eff_b, blob_refs), op)
-                )
+                self._apply_update(sub, txn)
+            return
+        state = None
+        if ty.require_state_downstream(op):
+            state = self._read_states_with_overlay(
+                [(key, type_name, bucket)], txn
+            )[0]
+        for eff_a, eff_b, blob_refs in ty.downstream(
+            op, state, self.store.blobs, self.cfg
+        ):
+            txn.writeset.append(
+                (Effect(key, type_name, bucket, eff_a, eff_b, blob_refs), op)
+            )
 
     def commit_transaction(self, txn: Transaction) -> np.ndarray:
         assert txn.active

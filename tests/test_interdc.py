@@ -1,0 +1,156 @@
+"""Inter-DC replication: the multidc suites on a loopback fabric.
+
+Mirrors /root/reference/test/multidc/: multiple_dcs_SUITE (replication,
+parallel writes), inter_dc_repl_SUITE (causality, atomicity) and the
+message-loss catch-up path of inter_dc_sub_buf.
+"""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.interdc import DCReplica, LoopbackHub
+
+
+@pytest.fixture
+def dcs(cfg):
+    hub = LoopbackHub()
+    nodes = [AntidoteNode(cfg, dc_id=i) for i in range(3)]
+    reps = [DCReplica(n, hub, f"dc{i}") for i, n in enumerate(nodes)]
+    DCReplica.connect_all(reps)
+    return hub, nodes, reps
+
+
+def test_replication_basic(dcs):
+    hub, nodes, reps = dcs
+    vc = nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 5))])
+    hub.pump()
+    for n in nodes[1:]:
+        vals, _ = n.read_objects([("k", "counter_pn", "b")], clock=vc)
+        assert vals == [5]
+
+
+def test_replication_multi_shard_txn(dcs):
+    hub, nodes, reps = dcs
+    ups = [(i, "counter_pn", "b", ("increment", i + 1)) for i in range(10)]
+    vc = nodes[0].update_objects(ups)
+    hub.pump()
+    objs = [(i, "counter_pn", "b") for i in range(10)]
+    vals, _ = nodes[2].read_objects(objs, clock=vc)
+    assert vals == [i + 1 for i in range(10)]
+
+
+def test_causality_chain_across_dcs(dcs):
+    # write at DC0 -> read at DC1 -> dependent write at DC1 -> read at DC2
+    # (causality_test, /root/reference/test/multidc/inter_dc_repl_SUITE.erl:79-84)
+    hub, nodes, reps = dcs
+    vc0 = nodes[0].update_objects([("k", "set_aw", "b", ("add", "a"))])
+    hub.pump()
+    vals, vc1 = nodes[1].read_objects([("k", "set_aw", "b")], clock=vc0)
+    assert vals == [["a"]]
+    vc2 = nodes[1].update_objects([("k", "set_aw", "b", ("remove", "a"))],
+                                  clock=vc1)
+    hub.pump()
+    vals, _ = nodes[2].read_objects([("k", "set_aw", "b")], clock=vc2)
+    assert vals == [[]]
+
+
+def test_causal_gate_and_ping_revealed_gap(dcs):
+    # DC0 writes x; the txn message to DC2 is lost. DC1 observes x and
+    # writes y (dependent). DC2 must not expose a snapshot claiming x until
+    # a later DC0 ping reveals the gap and catch-up fills it.
+    hub, nodes, reps = dcs
+    hub.drop_next(0, 2, n=1)  # lose the txn message (heartbeats follow it)
+    vc0 = nodes[0].update_objects([("x", "counter_pn", "b", ("increment", 1))])
+    hub.pump()
+    vc1 = nodes[1].read_objects([("x", "counter_pn", "b")], clock=vc0)[1]
+    vc2 = nodes[1].update_objects([("y", "counter_pn", "b", ("increment", 2))],
+                                  clock=vc1)
+    hub.pump()
+    # x's shard at DC2 never saw DC0's commit: stable lane0 stuck below vc0
+    assert nodes[2].store.stable_vc()[0] < vc0[0]
+    # a DC0 heartbeat reveals the chain gap -> catch-up -> x arrives
+    reps[0].heartbeat()
+    hub.pump()
+    vals, _ = nodes[2].read_objects(
+        [("x", "counter_pn", "b"), ("y", "counter_pn", "b")], clock=vc2)
+    assert vals == [1, 2]
+
+
+def test_message_loss_triggers_catch_up(dcs):
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    hub.pump()
+    # lose DC0 -> DC1 messages for the next commit (txn + heartbeats)
+    hub.drop_next(0, 1, n=nodes[0].cfg.n_shards)
+    nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 10))])
+    hub.pump()
+    # next commit's chained opid reveals the gap; catch-up query fills it
+    vc = nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 100))])
+    hub.pump()
+    vals, _ = nodes[1].read_objects([("k", "counter_pn", "b")], clock=vc)
+    assert vals == [111]
+    assert hub.dropped > 0
+
+
+def test_concurrent_writes_converge(dcs):
+    hub, nodes, reps = dcs
+    # concurrent (unsynced) adds at all three DCs
+    nodes[0].update_objects([("s", "set_aw", "b", ("add", "a0"))])
+    nodes[1].update_objects([("s", "set_aw", "b", ("add", "a1"))])
+    nodes[2].update_objects([("s", "set_aw", "b", ("add", "a2"))])
+    hub.pump()
+    clocks = [n.store.dc_max_vc() for n in nodes]
+    target = np.max(np.stack(clocks), axis=0)
+    for n in nodes:
+        vals, _ = n.read_objects([("s", "set_aw", "b")], clock=target)
+        assert vals == [["a0", "a1", "a2"]]
+
+
+def test_concurrent_counter_increments_sum(dcs):
+    hub, nodes, reps = dcs
+    for i, n in enumerate(nodes):
+        n.update_objects([("c", "counter_pn", "b", ("increment", 10 ** i))])
+    hub.pump()
+    target = np.max(np.stack([n.store.dc_max_vc() for n in nodes]), axis=0)
+    for n in nodes:
+        vals, _ = n.read_objects([("c", "counter_pn", "b")], clock=target)
+        assert vals == [111]
+
+
+def test_stable_snapshot_advances_via_heartbeats(dcs):
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    hub.pump()
+    # all shards of DC1 saw DC0's heartbeat, so stable advances even though
+    # only one shard got the txn
+    stable = nodes[1].store.stable_vc()
+    assert stable[0] >= 1
+
+
+def test_atomicity_across_dcs(dcs):
+    # a multi-key txn is visible atomically at remote DCs
+    # (atomicity_test, inter_dc_repl_SUITE)
+    hub, nodes, reps = dcs
+    txn_updates = [
+        ("a", "counter_pn", "b", ("increment", 1)),
+        ("b", "counter_pn", "b", ("increment", 1)),
+    ]
+    vc = nodes[0].update_objects(txn_updates)
+    hub.pump()
+    vals, _ = nodes[1].read_objects(
+        [("a", "counter_pn", "b"), ("b", "counter_pn", "b")], clock=vc)
+    assert vals == [1, 1]
+
+
+def test_lww_register_across_dcs(dcs):
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("r", "register_lww", "b", ("assign", "first"))])
+    hub.pump()
+    vc = nodes[1].update_objects([("r", "register_lww", "b", ("assign", "second"))])
+    hub.pump()
+    target = np.max(np.stack([n.store.dc_max_vc() for n in nodes]), axis=0)
+    for n in nodes:
+        vals, _ = n.read_objects([("r", "register_lww", "b")], clock=target)
+        assert vals == ["second"]

@@ -1,0 +1,142 @@
+"""RGA sequence and map composite semantics (reference types
+antidote_crdt_rga / antidote_crdt_map_rr / antidote_crdt_map_go)."""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.interdc import DCReplica, LoopbackHub
+
+
+@pytest.fixture
+def node(cfg):
+    return AntidoteNode(cfg)
+
+
+# ---------------------------------------------------------------- RGA
+
+def test_rga_insert_delete(node):
+    k = ("doc", "rga", "b")
+    node.update_objects([("doc", "rga", "b", ("insert", (0, "a")))])
+    node.update_objects([("doc", "rga", "b", ("insert", (1, "c")))])
+    node.update_objects([("doc", "rga", "b", ("insert", (1, "b")))])
+    vals, _ = node.read_objects([k])
+    assert vals == [["a", "b", "c"]]
+    node.update_objects([("doc", "rga", "b", ("delete", 1))])
+    vals, _ = node.read_objects([k])
+    assert vals == [["a", "c"]]
+    # insert after a tombstone keeps order
+    node.update_objects([("doc", "rga", "b", ("insert", (1, "x")))])
+    vals, _ = node.read_objects([k])
+    assert vals == [["a", "x", "c"]]
+
+
+def test_rga_head_inserts(node):
+    for ch in "cba":
+        node.update_objects([("doc", "rga", "b", ("insert", (0, ch)))])
+    vals, _ = node.read_objects([("doc", "rga", "b")])
+    assert vals == [["a", "b", "c"]]
+
+
+def test_rga_concurrent_inserts_converge(cfg):
+    # two DCs insert concurrently after the same origin; all replicas
+    # converge on the same order
+    hub = LoopbackHub()
+    nodes = [AntidoteNode(cfg, dc_id=i) for i in range(2)]
+    reps = [DCReplica(n, hub) for n in nodes]
+    DCReplica.connect_all(reps)
+    vc = nodes[0].update_objects([("doc", "rga", "b", ("insert", (0, "base")))])
+    hub.pump()
+    nodes[0].update_objects([("doc", "rga", "b", ("insert", (1, "L")))],
+                            clock=vc)
+    nodes[1].update_objects([("doc", "rga", "b", ("insert", (1, "R")))],
+                            clock=vc)
+    hub.pump()
+    target = np.max(np.stack([n.store.dc_max_vc() for n in nodes]), axis=0)
+    seqs = []
+    for n in nodes:
+        vals, _ = n.read_objects([("doc", "rga", "b")], clock=target)
+        seqs.append(vals[0])
+    assert seqs[0] == seqs[1]
+    assert sorted(seqs[0]) == ["L", "R", "base"]
+    assert seqs[0][0] == "base"
+
+
+def test_rga_index_errors(node):
+    node.update_objects([("doc", "rga", "b", ("insert", (0, "a")))])
+    with pytest.raises(IndexError):
+        node.update_objects([("doc", "rga", "b", ("insert", (5, "x")))])
+    with pytest.raises(IndexError):
+        node.update_objects([("doc", "rga", "b", ("delete", 3))])
+
+
+# ---------------------------------------------------------------- maps
+
+def test_map_go_update_and_read(node):
+    k = ("m", "map_go", "b")
+    node.update_objects([("m", "map_go", "b", ("update", {
+        ("clicks", "counter_pn"): ("increment", 3),
+        ("name", "register_lww"): ("assign", "zoe"),
+    }))])
+    vals, _ = node.read_objects([k])
+    assert vals == [{
+        ("clicks", "counter_pn"): 3,
+        ("name", "register_lww"): "zoe",
+    }]
+    node.update_objects([("m", "map_go", "b", ("update", {
+        ("clicks", "counter_pn"): ("increment", 2),
+    }))])
+    vals, _ = node.read_objects([k])
+    assert vals[0][("clicks", "counter_pn")] == 5
+
+
+def test_map_rr_remove(node):
+    k = ("m", "map_rr", "b")
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("tags", "set_aw"): ("add_all", ["x", "y"]),
+        ("n", "counter_fat"): ("increment", 4),
+    }))])
+    node.update_objects([("m", "map_rr", "b", ("remove", ("n", "counter_fat")))])
+    vals, _ = node.read_objects([k])
+    assert vals == [{("tags", "set_aw"): ["x", "y"]}]
+    # re-adding the field after reset starts fresh (counter_fat has reset)
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("n", "counter_fat"): ("increment", 1),
+    }))])
+    vals, _ = node.read_objects([k])
+    assert vals[0][("n", "counter_fat")] == 1
+
+
+def test_map_nested_map(node):
+    k = ("m", "map_rr", "b")
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("inner", "map_rr"): ("update", {("c", "counter_pn"): ("increment", 9)}),
+    }))])
+    vals, _ = node.read_objects([k])
+    assert vals == [{("inner", "map_rr"): {("c", "counter_pn"): 9}}]
+
+
+def test_map_read_your_writes_in_txn(node):
+    txn = node.start_transaction()
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("c", "counter_pn"): ("increment", 2),
+    }))], txn)
+    assert node.read_objects([("m", "map_rr", "b")], txn) == [
+        {("c", "counter_pn"): 2}
+    ]
+    node.abort_transaction(txn)
+    vals, _ = node.read_objects([("m", "map_rr", "b")])
+    assert vals == [{}]
+
+
+def test_map_replicates(cfg):
+    hub = LoopbackHub()
+    nodes = [AntidoteNode(cfg, dc_id=i) for i in range(2)]
+    reps = [DCReplica(n, hub) for n in nodes]
+    DCReplica.connect_all(reps)
+    vc = nodes[0].update_objects([("m", "map_rr", "b", ("update", {
+        ("s", "set_aw"): ("add", "v"),
+    }))])
+    hub.pump()
+    vals, _ = nodes[1].read_objects([("m", "map_rr", "b")], clock=vc)
+    assert vals == [{("s", "set_aw"): ["v"]}]
