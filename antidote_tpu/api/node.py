@@ -67,6 +67,12 @@ class AntidoteNode:
             )
         self.store = KVStore(self.cfg, sharding=sharding, log=log)
         self.txm = TransactionManager(self.store, my_dc=dc_id, cert=cert)
+        from antidote_tpu.obs import NodeMetrics
+
+        #: prometheus-parity metric set (antidote_stats_collector, SURVEY §2.7)
+        self.metrics = NodeMetrics()
+        self.txm.metrics = self.metrics
+        self._metrics_server = None
         if recover and log is not None:
             # node restart: replay the durable log into the device tables
             # and rebuild the certification table + commit counter
@@ -115,6 +121,24 @@ class AntidoteNode:
 
     def stable_vc(self) -> np.ndarray:
         return self.store.stable_vc()
+
+    # --- observability (elli /metrics on :3001 in the reference,
+    #     /root/reference/src/antidote_sup.erl:118-128) ------------------
+    def serve_metrics(self, port: Optional[int] = None):
+        from antidote_tpu.obs import MetricsServer
+        from antidote_tpu.obs.server import DEFAULT_METRICS_PORT
+
+        if port is None:
+            port = DEFAULT_METRICS_PORT
+        if self._metrics_server is not None:
+            if port not in (0, self._metrics_server.port):
+                raise RuntimeError(
+                    f"metrics already served on port "
+                    f"{self._metrics_server.port}, not {port}"
+                )
+            return self._metrics_server
+        self._metrics_server = MetricsServer(self.metrics.registry, port=port)
+        return self._metrics_server
 
 
 __all__ = ["AntidoteNode", "AbortError"]

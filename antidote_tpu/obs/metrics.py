@@ -1,0 +1,237 @@
+"""Prometheus-style metrics registry.
+
+The metric set mirrors ``antidote_stats_collector``
+(/root/reference/src/antidote_stats_collector.erl:80-93):
+
+  antidote_error_count                counter
+  antidote_staleness                  histogram (ms buckets 1..10000)
+  antidote_open_transactions          gauge
+  antidote_aborted_transactions_total counter
+  antidote_operations_total{type}     counter (read | read_async | update)
+
+plus framework-native extras (device launch timing, commit batch sizes).
+Exposition follows the prometheus text format so the reference's Grafana
+dashboard queries (monitoring/Antidote-Dashboard.json) keep working.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "", label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:  # the HTTP server scrapes from another thread
+            vals = dict(self._values)
+        if not self.label_names and not vals:
+            vals = {(): 0.0}
+        for key, v in sorted(vals.items()):
+            labels = dict(zip(self.label_names, key))
+            out.append(f"{self.name}{_fmt_labels(labels)} {v:g}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self._value:g}",
+        ]
+
+
+#: the reference's staleness buckets: ms 1..10000
+#: (/root/reference/src/antidote_stats_collector.erl:82)
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 10000)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = 0
+            while i < len(self.buckets) and v > self.buckets[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile from bucket counts (upper bound)."""
+        if self._n == 0:
+            return 0.0
+        target = q * self._n
+        acc = 0
+        for i, c in enumerate(self._counts):
+            acc += c
+            if acc >= target:
+                return float(self.buckets[i]) if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:  # consistent (buckets, sum, count) snapshot
+            counts, total, n = list(self._counts), self._sum, self._n
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        acc += counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
+        out.append(f"{self.name}_sum {total:g}")
+        out.append(f"{self.name}_count {n}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            assert metric.name not in self._metrics, metric.name
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name, help_="", label_names=()):
+        return self.register(Counter(name, help_, tuple(label_names)))
+
+    def gauge(self, name, help_=""):
+        return self.register(Gauge(name, help_))
+
+    def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_, buckets))
+
+    def get(self, name):
+        return self._metrics[name]
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class NodeMetrics:
+    """The per-replica metric set, named as in the reference."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.error_count = r.counter(
+            "antidote_error_count", "Number of error messages logged"
+        )
+        self.staleness = r.histogram(
+            "antidote_staleness", "Staleness of the stable snapshot (ms)"
+        )
+        self.open_transactions = r.gauge(
+            "antidote_open_transactions", "Number of open interactive transactions"
+        )
+        self.aborted_transactions = r.counter(
+            "antidote_aborted_transactions_total", "Aborted transactions"
+        )
+        self.operations = r.counter(
+            "antidote_operations_total", "Operations by type", ("type",)
+        )
+        # framework-native extras
+        self.device_launch_seconds = r.histogram(
+            "antidote_device_launch_seconds",
+            "Wall time of device kernel launches (s)",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.commit_batch_size = r.histogram(
+            "antidote_commit_batch_size", "Effects per commit batch",
+            buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384),
+        )
+
+    # -- staleness observer (every 10 s in the reference,
+    #    /root/reference/src/antidote_stats_collector.erl:87-93); here it
+    #    is called by whoever owns a clock source, typically the node.
+    def observe_staleness(self, ms: float) -> None:
+        self.staleness.observe(ms)
+
+
+class _ErrorCountHandler(logging.Handler):
+    def __init__(self, metrics: NodeMetrics):
+        super().__init__(level=logging.ERROR)
+        self.metrics = metrics
+
+    def emit(self, record):
+        self.metrics.error_count.inc()
+
+
+def install_error_monitor(metrics: NodeMetrics,
+                          logger: Optional[logging.Logger] = None):
+    """Hook the logging tree so every ERROR-level record bumps
+    ``antidote_error_count`` (antidote_error_monitor,
+    /root/reference/src/antidote_error_monitor.erl:36-48).  Returns the
+    handler so callers can remove it."""
+    h = _ErrorCountHandler(metrics)
+    (logger or logging.getLogger()).addHandler(h)
+    return h
+
+
+def staleness_ms(wallclock_of_stable_entry: float) -> float:
+    """now − min stable-snapshot entry, in ms (the reference computes this
+    from its physical-clock VCs; our logical clocks need a wallclock map)."""
+    return max(0.0, (time.time() - wallclock_of_stable_entry) * 1e3)

@@ -1,0 +1,47 @@
+"""Per-launch timing and JAX profiler hooks.
+
+The reference has no per-request tracing (SURVEY §5 notes the gap and asks
+the rebuild to add profiler hooks from day one).  ``Timer`` feeds the
+``antidote_device_launch_seconds`` histogram; ``trace_span`` wraps a block
+in a ``jax.profiler.TraceAnnotation`` when profiling is active, and is a
+plain timer otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+class Timer:
+    """Context manager: measure a block, optionally feed a histogram."""
+
+    def __init__(self, histogram=None):
+        self.histogram = histogram
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        if self.histogram is not None:
+            self.histogram.observe(self.elapsed)
+        return False
+
+
+@contextlib.contextmanager
+def trace_span(name: str, histogram=None):
+    """Named span: shows up in a JAX profiler trace (``jax.profiler
+    .start_trace``) and in the launch-seconds histogram."""
+    import jax
+
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if histogram is not None:
+            histogram.observe(time.perf_counter() - t0)

@@ -82,7 +82,9 @@ class TransactionManager:
         #: /root/reference/src/clocksi_interactive_coord.erl:915-926);
         #: the inter-DC layer points this at its message pump
         self.on_clock_wait = lambda: None
-        self.metrics = None  # wired by obs layer
+        #: NodeMetrics — the coordinator's counter bumps
+        #: (/root/reference/src/clocksi_interactive_coord.erl:667,734,849-870)
+        self.metrics = None
 
     # ------------------------------------------------------------------
     # transaction lifecycle (antidote.erl API shapes)
@@ -113,10 +115,17 @@ class TransactionManager:
                     f"{clock}"
                 )
             snap = np.maximum(snap, clock)
+        if self.metrics is not None:
+            self.metrics.open_transactions.inc()
         return Transaction(snap, props)
 
-    def read_objects(self, objects: Sequence[BoundObject], txn: Transaction):
+    def read_objects(self, objects: Sequence[BoundObject], txn: Transaction,
+                     _internal: bool = False):
         assert txn.active
+        # count client-level reads only — internal recursions (map fields,
+        # downstream state reads) would inflate the dashboard rates
+        if self.metrics is not None and not _internal:
+            self.metrics.operations.inc(len(objects), type="read")
         out: List[Any] = [None] * len(objects)
         plain = []
         for i, (key, t, bucket) in enumerate(objects):
@@ -139,14 +148,14 @@ class TransactionManager:
 
         memb = self.read_objects(
             [(maps_mod.member_key(key), maps_mod.MAP_MEMBERSHIP[map_type],
-              bucket)], txn
+              bucket)], txn, _internal=True,
         )[0]
         fields = [tuple(x) for x in memb]
         if not fields:
             return {}
         nested = self.read_objects(
             [(maps_mod.field_key(key, f, ft), ft, bucket) for f, ft in fields],
-            txn,
+            txn, _internal=True,
         )
         return {
             (f, ft): v for (f, ft), v in zip(fields, nested)
@@ -154,6 +163,8 @@ class TransactionManager:
 
     def update_objects(self, updates: Sequence[Update], txn: Transaction) -> None:
         assert txn.active
+        if self.metrics is not None:
+            self.metrics.operations.inc(len(updates), type="update")
         for u in updates:
             self._apply_update(u, txn, run_hooks=True)
 
@@ -170,18 +181,18 @@ class TransactionManager:
                     key, type_name, bucket, op
                 )
             except Exception as e:
-                txn.active = False
+                self._mark_aborted(txn)
                 raise AbortError(f"pre-commit hook failed: {e}") from e
             # re-validate the hook-transformed update: a misbehaving hook
             # must abort, not generate malformed effects
             if not is_type(type_name):
-                txn.active = False
+                self._mark_aborted(txn)
                 raise AbortError(
                     f"pre-commit hook produced unknown type {type_name!r}"
                 )
             ty = get_type(type_name)
             if not ty.is_operation(op):
-                txn.active = False
+                self._mark_aborted(txn)
                 raise AbortError(
                     f"pre-commit hook produced invalid op {op!r} for {type_name}"
                 )
@@ -191,7 +202,8 @@ class TransactionManager:
             from antidote_tpu.crdt import maps as maps_mod
 
             def read_field_value(fk, ft):
-                return self.read_objects([(fk, ft, bucket)], txn)[0]
+                return self.read_objects([(fk, ft, bucket)], txn,
+                                         _internal=True)[0]
 
             for sub in maps_mod.expand_update(
                 key, type_name, bucket, op, read_field_value
@@ -213,16 +225,23 @@ class TransactionManager:
     def commit_transaction(self, txn: Transaction) -> np.ndarray:
         assert txn.active
         txn.active = False
+        if self.metrics is not None:
+            self.metrics.open_transactions.dec()
         if not txn.writeset:
             return txn.snapshot_vc.copy()
         # certification: abort if any written key saw a commit after our
         # snapshot (first-committer-wins, certification_check,
-        # /root/reference/src/clocksi_vnode.erl:588-632)
-        if self.cert:
+        # /root/reference/src/clocksi_vnode.erl:588-632); per-txn certify
+        # override mirrors the txn_props certify flag
+        # (/root/reference/src/clocksi_interactive_coord.erl get_txn_property)
+        cert = txn.props.get("certify", self.cert)
+        if cert:
             snap_here = int(txn.snapshot_vc[self.my_dc])
             for eff, _ in txn.writeset:
                 last = self.committed_keys.get((eff.key, eff.bucket), 0)
                 if last > snap_here:
+                    if self.metrics is not None:
+                        self.metrics.aborted_transactions.inc()
                     raise AbortError(
                         f"certification conflict on key {eff.key!r}"
                     )
@@ -230,6 +249,8 @@ class TransactionManager:
         commit_vc = txn.snapshot_vc.copy()
         commit_vc[self.my_dc] = self.commit_counter
         effects = [e for e, _ in txn.writeset]
+        if self.metrics is not None:
+            self.metrics.commit_batch_size.observe(len(effects))
         self.store.apply_effects(
             effects, [commit_vc] * len(effects), [self.my_dc] * len(effects)
         )
@@ -243,8 +264,15 @@ class TransactionManager:
             )
         return commit_vc
 
-    def abort_transaction(self, txn: Transaction) -> None:
+    def _mark_aborted(self, txn: Transaction) -> None:
+        """Close an active txn as aborted, keeping the gauge/counter exact."""
+        if txn.active and self.metrics is not None:
+            self.metrics.open_transactions.dec()
+            self.metrics.aborted_transactions.inc()
         txn.active = False
+
+    def abort_transaction(self, txn: Transaction) -> None:
+        self._mark_aborted(txn)
         txn.writeset.clear()
 
     # ------------------------------------------------------------------
