@@ -7,6 +7,7 @@ over one replica's TransactionManager + KVStore.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -67,11 +68,15 @@ class AntidoteNode:
             )
         self.store = KVStore(self.cfg, sharding=sharding, log=log)
         self.txm = TransactionManager(self.store, my_dc=dc_id, cert=cert)
-        from antidote_tpu.obs import NodeMetrics
+        from antidote_tpu.obs import NodeMetrics, install_error_monitor
 
         #: prometheus-parity metric set (antidote_stats_collector, SURVEY §2.7)
         self.metrics = NodeMetrics()
         self.txm.metrics = self.metrics
+        # count this package's ERROR-level log records (antidote_error_monitor)
+        self._error_handler = install_error_monitor(
+            self.metrics, logging.getLogger("antidote_tpu")
+        )
         self._metrics_server = None
         if recover and log is not None:
             # node restart: replay the durable log into the device tables

@@ -149,7 +149,8 @@ class MetricsRegistry:
 
     def register(self, metric):
         with self._lock:
-            assert metric.name not in self._metrics, metric.name
+            if metric.name in self._metrics:
+                raise ValueError(f"metric {metric.name!r} already registered")
             self._metrics[metric.name] = metric
         return metric
 

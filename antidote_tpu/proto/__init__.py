@@ -1,0 +1,21 @@
+"""Client wire protocol (SURVEY §2.1).
+
+The reference speaks length-prefixed protobuf over TCP port 8087
+(antidote_pb_protocol / antidote_pb_process / antidote_pb_sup,
+/root/reference/src/antidote_pb_protocol.erl:42-88).  Here the same
+semantic surface rides 4-byte-length frames carrying a 1-byte message code
+plus a msgpack body.
+"""
+
+from antidote_tpu.proto.client import AntidoteClient
+from antidote_tpu.proto.codec import MessageCode, decode, encode
+from antidote_tpu.proto.server import ProtocolServer, DEFAULT_PORT
+
+__all__ = [
+    "AntidoteClient",
+    "MessageCode",
+    "ProtocolServer",
+    "DEFAULT_PORT",
+    "decode",
+    "encode",
+]

@@ -1,0 +1,105 @@
+"""Python client for the wire protocol — the antidotec_pb analogue.
+
+One socket, request/response in lockstep (the reference client multiplexes
+the same way: each request waits for its reply before the next —
+/root/reference/src/antidote_pb_protocol.erl:51-64 is a strict loop).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+from antidote_tpu.proto.codec import (
+    MessageCode,
+    decode,
+    decode_value,
+    read_frame,
+    write_message,
+)
+
+
+class RemoteAbort(Exception):
+    """Server aborted the transaction."""
+
+
+class RemoteError(Exception):
+    """Server-side error reply."""
+
+
+class ClientTxn:
+    def __init__(self, client: "AntidoteClient", txid: int):
+        self._client = client
+        self.txid = txid
+
+    def read_objects(self, objects: Sequence[Tuple[Any, str, str]]) -> List[Any]:
+        body = self._client._call(MessageCode.READ_OBJECTS, {
+            "txid": self.txid, "objects": list(objects),
+        })
+        return [decode_value(v) for v in body["values"]]
+
+    def update_objects(self, updates: Sequence[Tuple]) -> None:
+        self._client._call(MessageCode.UPDATE_OBJECTS, {
+            "txid": self.txid, "updates": list(updates),
+        })
+
+    def commit(self) -> List[int]:
+        body = self._client._call(MessageCode.COMMIT_TRANSACTION,
+                                  {"txid": self.txid})
+        return body["commit_clock"]
+
+    def abort(self) -> None:
+        self._client._call(MessageCode.ABORT_TRANSACTION, {"txid": self.txid})
+
+
+class AntidoteClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8087,
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _call(self, code: MessageCode, body: Any):
+        with self._lock:
+            write_message(self._sock, code, body)
+            resp_code, resp = decode(read_frame(self._sock))
+        if resp_code == MessageCode.ERROR_RESP:
+            if resp.get("error") == "aborted":
+                raise RemoteAbort(resp.get("detail", ""))
+            raise RemoteError(f"{resp.get('error')}: {resp.get('detail')}")
+        return resp
+
+    # ------------------------------------------------------------------
+    def start_transaction(self, clock: Optional[Sequence[int]] = None,
+                          props: Optional[dict] = None) -> ClientTxn:
+        body = self._call(MessageCode.START_TRANSACTION, {
+            "clock": None if clock is None else [int(x) for x in clock],
+            "props": props,
+        })
+        return ClientTxn(self, body["txid"])
+
+    def update_objects(self, updates: Sequence[Tuple],
+                       clock: Optional[Sequence[int]] = None) -> List[int]:
+        body = self._call(MessageCode.STATIC_UPDATE_OBJECTS, {
+            "updates": list(updates),
+            "clock": None if clock is None else [int(x) for x in clock],
+        })
+        return body["commit_clock"]
+
+    def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
+                     clock: Optional[Sequence[int]] = None):
+        body = self._call(MessageCode.STATIC_READ_OBJECTS, {
+            "objects": list(objects),
+            "clock": None if clock is None else [int(x) for x in clock],
+        })
+        return ([decode_value(v) for v in body["values"]],
+                body["commit_clock"])
+
+    def get_connection_descriptor(self) -> dict:
+        return self._call(MessageCode.GET_CONNECTION_DESCRIPTOR,
+                          {})["descriptor"]
+
+    def close(self) -> None:
+        self._sock.close()

@@ -1,0 +1,102 @@
+"""Frame + message codec.
+
+Framing mirrors ``antidote_pb_protocol``: a 4-byte big-endian length
+prefix, then a 1-byte message code and the body
+(/root/reference/src/antidote_pb_protocol.erl:42-64 — ``{packet, 4}``
+plus the msg-code byte handled by antidote_pb_codec).  The body is msgpack
+rather than protobuf; the request set mirrors the ``antidote_pb_process``
+clauses (/root/reference/src/antidote_pb_process.erl:49-135).
+"""
+
+from __future__ import annotations
+
+import enum
+import socket
+import struct
+from typing import Any, Tuple
+
+import msgpack
+
+
+class MessageCode(enum.IntEnum):
+    # requests (antidote_pb_process:process/1 clauses)
+    START_TRANSACTION = 1
+    READ_OBJECTS = 2
+    UPDATE_OBJECTS = 3
+    COMMIT_TRANSACTION = 4
+    ABORT_TRANSACTION = 5
+    STATIC_UPDATE_OBJECTS = 6
+    STATIC_READ_OBJECTS = 7
+    GET_CONNECTION_DESCRIPTOR = 8
+    CONNECT_TO_DCS = 9
+    CREATE_DC = 10
+    # responses
+    OPERATION_RESP = 64
+    START_TRANSACTION_RESP = 65
+    READ_OBJECTS_RESP = 66
+    COMMIT_RESP = 67
+    ERROR_RESP = 127
+
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def freeze(x: Any) -> Any:
+    """msgpack round-trips tuples as lists; keys and ops must come back
+    hashable/structured, so freeze lists into tuples recursively."""
+    if isinstance(x, list):
+        return tuple(freeze(v) for v in x)
+    return x
+
+
+def encode_value(v: Any) -> Any:
+    """Client-visible CRDT values may be dicts keyed by (field, type)
+    tuples (map_rr/map_go); msgpack maps cannot carry tuple keys, so dicts
+    ride as tagged pair lists."""
+    if isinstance(v, dict):
+        return {"__map__": [[list(k), encode_value(x)] for k, x in v.items()]}
+    if isinstance(v, (list, tuple)):
+        return [encode_value(x) for x in v]
+    return v
+
+
+def decode_value(v: Any) -> Any:
+    if isinstance(v, dict) and "__map__" in v:
+        return {freeze(k): decode_value(x) for k, x in v["__map__"]}
+    if isinstance(v, list):
+        return [decode_value(x) for x in v]
+    return v
+
+
+def encode(code: MessageCode, body: Any) -> bytes:
+    payload = msgpack.packb(body, use_bin_type=True)
+    return struct.pack(">IB", len(payload) + 1, int(code)) + payload
+
+
+def decode(frame: bytes) -> Tuple[MessageCode, Any]:
+    code = MessageCode(frame[0])
+    body = msgpack.unpackb(frame[1:], raw=False, strict_map_key=False)
+    return code, body
+
+
+def read_frame(sock: socket.socket) -> bytes:
+    """Read one length-prefixed frame (code byte + body) off a socket."""
+    hdr = _read_exact(sock, 4)
+    (n,) = struct.unpack(">I", hdr)
+    if not 1 <= n <= MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    return _read_exact(sock, n)
+
+
+def write_message(sock: socket.socket, code: MessageCode, body: Any) -> None:
+    sock.sendall(encode(code, body))
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,176 @@
+"""Protocol server: TCP acceptor pool + request dispatcher.
+
+The ranch listener (100 acceptors, max 1024 conns, port 8087 —
+/root/reference/src/antidote_pb_sup.erl:47-56) becomes a
+``ThreadingTCPServer``; the decode→process→encode loop with error replies
+mirrors ``antidote_pb_protocol:loop/handle``
+(/root/reference/src/antidote_pb_protocol.erl:51-88), and the dispatch
+table mirrors ``antidote_pb_process:process/1``
+(/root/reference/src/antidote_pb_process.erl:49-135).
+
+The node's transaction manager is a single commit stream, so requests are
+serialized through one lock — concurrency buys pipelining of socket IO,
+matching the single-writer-per-partition design (SURVEY §2.10 row 2).
+"""
+
+from __future__ import annotations
+
+import logging
+import socketserver
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.proto.codec import (
+    MessageCode,
+    decode,
+    encode_value,
+    freeze,
+    read_frame,
+    write_message,
+)
+from antidote_tpu.txn.manager import AbortError, Transaction
+
+DEFAULT_PORT = 8087
+log = logging.getLogger(__name__)
+
+
+def _decode_objects(objs):
+    return [(freeze(k), t, b) for k, t, b in (freeze(o) for o in objs)]
+
+
+def _decode_updates(ups):
+    return [(freeze(k), t, b, freeze(op)) for k, t, b, op in
+            (freeze(u) for u in ups)]
+
+
+def _vc(x) -> Optional[np.ndarray]:
+    return None if x is None else np.asarray(x, np.int32)
+
+
+class ProtocolServer:
+    def __init__(self, node: AntidoteNode, host: str = "127.0.0.1",
+                 port: int = 0, interdc=None):
+        self.node = node
+        #: DCReplica for the descriptor/connect requests (optional)
+        self.interdc = interdc
+        self._lock = threading.Lock()
+        self._txns: Dict[int, Transaction] = {}
+        handler = self._make_handler()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"antidote-proto:{self.port}",
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def _make_handler(server_self):
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        frame = read_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        code, body = decode(frame)
+                        resp_code, resp = server_self._process(code, body)
+                    except AbortError as e:
+                        resp_code, resp = MessageCode.ERROR_RESP, {
+                            "error": "aborted", "detail": str(e)
+                        }
+                    except Exception as e:  # error reply, keep the conn
+                        log.exception("request failed")
+                        resp_code, resp = MessageCode.ERROR_RESP, {
+                            "error": type(e).__name__, "detail": str(e)
+                        }
+                    try:
+                        write_message(self.request, resp_code, resp)
+                    except (ConnectionError, OSError):
+                        return
+
+        return Handler
+
+    # ------------------------------------------------------------------
+    def _process(self, code: MessageCode, body: Any):
+        with self._lock:
+            return self._dispatch(code, body)
+
+    def _dispatch(self, code: MessageCode, body: Any):
+        node = self.node
+        if code == MessageCode.START_TRANSACTION:
+            txn = node.start_transaction(
+                clock=_vc(body.get("clock")), props=body.get("props"),
+            )
+            self._txns[txn.txid] = txn
+            return MessageCode.START_TRANSACTION_RESP, {"txid": txn.txid}
+        if code == MessageCode.READ_OBJECTS:
+            txn = self._txn(body["txid"])
+            vals = node.read_objects(_decode_objects(body["objects"]), txn)
+            return MessageCode.READ_OBJECTS_RESP, {
+                "values": [encode_value(v) for v in vals]
+            }
+        if code == MessageCode.UPDATE_OBJECTS:
+            txn = self._txn(body["txid"])
+            try:
+                node.update_objects(_decode_updates(body["updates"]), txn)
+            except AbortError:
+                self._txns.pop(body["txid"], None)
+                raise
+            return MessageCode.OPERATION_RESP, {"ok": True}
+        if code == MessageCode.COMMIT_TRANSACTION:
+            txn = self._txns.pop(body["txid"])
+            commit_vc = node.commit_transaction(txn)
+            return MessageCode.COMMIT_RESP, {
+                "commit_clock": [int(x) for x in commit_vc]
+            }
+        if code == MessageCode.ABORT_TRANSACTION:
+            txn = self._txns.pop(body["txid"])
+            node.abort_transaction(txn)
+            return MessageCode.OPERATION_RESP, {"ok": True}
+        if code == MessageCode.STATIC_UPDATE_OBJECTS:
+            vc = node.update_objects(
+                _decode_updates(body["updates"]), clock=_vc(body.get("clock"))
+            )
+            return MessageCode.COMMIT_RESP, {
+                "commit_clock": [int(x) for x in vc]
+            }
+        if code == MessageCode.STATIC_READ_OBJECTS:
+            vals, vc = node.read_objects(
+                _decode_objects(body["objects"]), clock=_vc(body.get("clock"))
+            )
+            return MessageCode.READ_OBJECTS_RESP, {
+                "values": [encode_value(v) for v in vals],
+                "commit_clock": [int(x) for x in vc],
+            }
+        if code == MessageCode.GET_CONNECTION_DESCRIPTOR:
+            if self.interdc is None:
+                raise RuntimeError("no inter-DC replica attached")
+            d = self.interdc.descriptor()
+            return MessageCode.OPERATION_RESP, {
+                "descriptor": {"dc_id": d.dc_id, "name": d.name,
+                               "n_shards": d.n_shards,
+                               "address": d.address},
+            }
+        raise ValueError(f"unhandled message code {code!r}")
+
+    def _txn(self, txid: int) -> Transaction:
+        txn = self._txns.get(txid)
+        if txn is None:
+            raise KeyError(f"unknown or finished transaction {txid}")
+        return txn
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)

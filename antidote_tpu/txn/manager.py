@@ -293,7 +293,12 @@ class TransactionManager:
         self, objects: Sequence[BoundObject], clock: Optional[np.ndarray] = None
     ):
         txn = self.start_transaction(clock)
-        vals = self.read_objects(objects, txn)
+        try:
+            vals = self.read_objects(objects, txn)
+        except Exception:
+            self.abort_transaction(txn)
+            raise
+        self.commit_transaction(txn)  # empty writeset: closes the txn
         return vals, txn.snapshot_vc
 
     # ------------------------------------------------------------------

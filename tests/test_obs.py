@@ -98,6 +98,8 @@ def test_map_read_counts_one_client_op():
     vals, _ = node.read_objects([("m", "map_rr", "b")])
     assert vals[0][("f1", "counter_pn")] == 2
     assert node.metrics.operations.value(type="read") == before + 1
+    # static reads must close their internal txn (gauge leak regression)
+    assert node.metrics.open_transactions.value() == 0
 
 
 def test_error_monitor_increments_error_count():

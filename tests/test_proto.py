@@ -1,0 +1,150 @@
+"""Wire-protocol tests over a real TCP socket — the analogue of
+``pb_client_SUITE`` (/root/reference/test/singledc/pb_client_SUITE.erl:85-102):
+per-CRDT coverage through the client, interactive transactions, abort,
+error replies, and causal-clock chaining."""
+
+import threading
+
+import pytest
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.proto.client import AntidoteClient, RemoteAbort, RemoteError
+from antidote_tpu.proto.server import ProtocolServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = AntidoteConfig(
+        n_shards=2, max_dcs=2, ops_per_key=8, snap_versions=2,
+        set_slots=8, rga_slots=16, keys_per_table=64, batch_buckets=(8, 64),
+    )
+    node = AntidoteNode(cfg)
+    srv = ProtocolServer(node, port=0)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = AntidoteClient(port=server.port)
+    yield c
+    c.close()
+
+
+def test_static_counter_roundtrip(client):
+    clock = client.update_objects([("pbc", "counter_pn", "b", ("increment", 4))])
+    vals, _ = client.read_objects([("pbc", "counter_pn", "b")], clock=clock)
+    assert vals[0] == 4
+
+
+def test_interactive_txn(client):
+    txn = client.start_transaction()
+    txn.update_objects([("pbi", "counter_pn", "b", ("increment", 2))])
+    # read-your-writes inside the txn
+    assert txn.read_objects([("pbi", "counter_pn", "b")])[0] == 2
+    clock = txn.commit()
+    vals, _ = client.read_objects([("pbi", "counter_pn", "b")], clock=clock)
+    assert vals[0] == 2
+
+
+def test_abort_discards_writes(client):
+    txn = client.start_transaction()
+    txn.update_objects([("pba", "counter_pn", "b", ("increment", 9))])
+    txn.abort()
+    vals, _ = client.read_objects([("pba", "counter_pn", "b")])
+    assert vals[0] == 0
+
+
+def test_per_crdt_coverage(client):
+    clock = client.update_objects([
+        ("s", "set_aw", "b", ("add", 7)),
+        ("s", "set_aw", "b", ("add", 9)),
+        ("r", "register_lww", "b", ("assign", "hello")),
+        ("mv", "register_mv", "b", ("assign", 5)),
+        ("f", "flag_ew", "b", ("enable", None)),
+        ("seq", "rga", "b", ("add_right", (0, "x"))),
+    ])
+    vals, _ = client.read_objects(
+        [("s", "set_aw", "b"), ("r", "register_lww", "b"),
+         ("mv", "register_mv", "b"), ("f", "flag_ew", "b"),
+         ("seq", "rga", "b")],
+        clock=clock,
+    )
+    assert sorted(vals[0]) == [7, 9]
+    assert vals[1] == "hello"
+    assert vals[2] == [5]
+    assert vals[3] is True
+    assert vals[4] == ["x"]
+
+
+def test_map_rr_over_wire(client):
+    clock = client.update_objects([
+        ("m", "map_rr", "b",
+         ("update", [(("cnt", "counter_pn"), ("increment", 3)),
+                     (("who", "register_lww"), ("assign", "ada"))])),
+    ])
+    vals, _ = client.read_objects([("m", "map_rr", "b")], clock=clock)
+    assert vals[0][("cnt", "counter_pn")] == 3
+    assert vals[0][("who", "register_lww")] == "ada"
+
+
+def test_certification_conflict_is_remote_abort(client):
+    t1 = client.start_transaction()
+    t2 = client.start_transaction()
+    t1.update_objects([("cert", "counter_pn", "b", ("increment", 1))])
+    t2.update_objects([("cert", "counter_pn", "b", ("increment", 1))])
+    t1.commit()
+    with pytest.raises(RemoteAbort):
+        t2.commit()
+
+
+def test_error_reply_keeps_connection(client):
+    with pytest.raises(RemoteError):
+        client.update_objects([("x", "no_such_type", "b", ("inc", 1))])
+    # connection still usable
+    clock = client.update_objects([("x2", "counter_pn", "b", ("increment", 1))])
+    vals, _ = client.read_objects([("x2", "counter_pn", "b")], clock=clock)
+    assert vals[0] == 1
+
+
+def test_unknown_txid_is_error(client):
+    with pytest.raises(RemoteError):
+        client._call_unknown_commit()
+
+
+# minimal helper used above — keeps the client API surface clean
+def _call_unknown_commit(self):
+    from antidote_tpu.proto.codec import MessageCode
+
+    return self._call(MessageCode.COMMIT_TRANSACTION, {"txid": 10**9})
+
+
+AntidoteClient._call_unknown_commit = _call_unknown_commit
+
+
+def test_concurrent_clients(server):
+    """Many clients hammer the acceptor pool concurrently; every increment
+    must land exactly once (the dispatcher serializes the commit stream)."""
+    n_clients, n_ops = 8, 10
+    errs = []
+
+    def work(i):
+        try:
+            c = AntidoteClient(port=server.port)
+            for _ in range(n_ops):
+                c.update_objects([("conc", "counter_pn", "b", ("increment", 1))])
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    c = AntidoteClient(port=server.port)
+    vals, _ = c.read_objects([("conc", "counter_pn", "b")])
+    c.close()
+    assert vals[0] == n_clients * n_ops
