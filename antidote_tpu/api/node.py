@@ -40,9 +40,16 @@ class AntidoteNode:
         cert: bool = True,
         log_dir: Optional[str] = None,
         recover: bool = False,
+        meta=None,
     ):
         self.cfg = cfg or AntidoteConfig()
         self.dc_id = dc_id
+        # durable, DC-replicated metadata/flag store (stable_meta_data_server)
+        if meta is None:
+            from antidote_tpu.meta import MetaDataStore
+
+            meta = MetaDataStore()
+        self.meta = meta
         log = None
         if log_dir is not None and self.cfg.enable_logging:
             import glob
@@ -61,13 +68,21 @@ class AntidoteNode:
                     f"log_dir {log_dir!r} contains existing WAL data; pass "
                     "recover=True (or point at an empty directory)"
                 )
-            log = LogManager(self.cfg, log_dir)
+            log = LogManager(
+                self.cfg, log_dir,
+                sync_on_commit=self.meta.get_env("sync_log",
+                                                 self.cfg.sync_log),
+            )
         elif recover:
             raise RuntimeError(
                 "recover=True requires log_dir and cfg.enable_logging"
             )
         self.store = KVStore(self.cfg, sharding=sharding, log=log)
-        self.txm = TransactionManager(self.store, my_dc=dc_id, cert=cert)
+        self.txm = TransactionManager(
+            self.store, my_dc=dc_id,
+            cert=self.meta.get_env("txn_cert", cert),
+            protocol=self.meta.get_env("txn_prot", "clocksi"),
+        )
         from antidote_tpu.obs import NodeMetrics, install_error_monitor
 
         #: prometheus-parity metric set (antidote_stats_collector, SURVEY §2.7)
@@ -85,6 +100,9 @@ class AntidoteNode:
             last = self.store.recover(track_origin=dc_id)
             self.txm.committed_keys.update(last)
             self.txm.commit_counter = int(self.store.dc_max_vc()[dc_id])
+        # react to replicated flag flips from ANY node in the DC
+        # (registered last: construction-time get_env seeds fire watchers)
+        self.meta.watch(self._on_meta_change)
 
     # --- transactions (antidote.erl:36-54) -----------------------------
     def start_transaction(self, clock=None, props=None) -> Transaction:
@@ -126,6 +144,19 @@ class AntidoteNode:
 
     def stable_vc(self) -> np.ndarray:
         return self.store.stable_vc()
+
+    def set_sync_log(self, sync: bool) -> None:
+        """Flip fsync-on-commit DC-wide (replicated runtime flag;
+        /root/reference/src/logging_vnode.erl:256-258).  The broadcast
+        reaches every member node's watcher, which applies it to its
+        running log."""
+        self.meta.set_env("sync_log", sync)
+
+    def _on_meta_change(self, key: str, value) -> None:
+        if key == "env:sync_log" and self.store.log is not None:
+            self.store.log.set_sync(bool(value))
+        elif key == "env:txn_cert":
+            self.txm.cert = bool(value)
 
     # --- observability (elli /metrics on :3001 in the reference,
     #     /root/reference/src/antidote_sup.erl:118-128) ------------------

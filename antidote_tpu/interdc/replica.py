@@ -52,8 +52,16 @@ class DCReplica:
             collections.defaultdict(collections.deque)
         )
         hub.register(self.dc_id, self._on_message, self._serve_log_query)
+        hub.register_request(self.dc_id, self._serve_request)
         node.txm.commit_listeners.append(self._on_local_commit)
         node.txm.on_clock_wait = self._on_clock_wait
+        # bcounter rights requests ride the query channel (?BCOUNTER_REQUEST)
+        node.txm.bcounters.request_transfer = (
+            lambda dc, key, bucket, n: self.hub.request(
+                dc, "bcounter", {"key": key, "bucket": bucket, "amount": n,
+                                 "to_dc": self.dc_id},
+            )
+        )
 
     # ------------------------------------------------------------------
     def descriptor(self) -> Descriptor:
@@ -116,6 +124,33 @@ class DCReplica:
                 effects=[], timestamp=safe,
             )
             self.hub.publish(self.dc_id, msg.to_bytes())
+
+    def _serve_request(self, kind: str, payload) -> object:
+        """Generic query-channel dispatch (inter_dc_query_receive_socket,
+        /root/reference/src/inter_dc_query_receive_socket.erl:111-139)."""
+        if kind == "bcounter":
+            return self.node.txm.bcounters.process_transfer(
+                self.node.txm, payload["key"], payload["bucket"],
+                payload["amount"], payload["to_dc"],
+            )
+        if kind == "check_up":
+            return True
+        raise ValueError(f"unknown request kind {kind!r}")
+
+    def bcounter_tick(self) -> int:
+        """Run one round of the rights-transfer loop (transfer_periodic,
+        /root/reference/src/bcounter_mgr.erl:131-146)."""
+        from antidote_tpu.crdt import get_type
+
+        ty = get_type("counter_b")
+        txm = self.node.txm
+
+        def read_state(key, bucket):
+            return txm.store.read_states(
+                [(key, "counter_b", bucket)], txm.store.dc_max_vc()
+            )[0]
+
+        return txm.bcounters.transfer_periodic(read_state, ty)
 
     def _serve_log_query(self, shard: int, origin: int,
                          from_opid: int) -> List[bytes]:

@@ -28,6 +28,8 @@ class LoopbackHub:
         self.subscribers: Dict[int, List[Callable[[bytes], None]]] = {}
         #: dc_id -> log-query handler (shard, origin, from_opid) -> [bytes]
         self.query_handlers: Dict[int, Callable] = {}
+        #: dc_id -> generic request handler (kind, payload) -> reply
+        self.request_handlers: Dict[int, Callable] = {}
         self.queues: collections.deque = collections.deque()
         #: (from_dc, to_dc) pairs whose next N messages are dropped
         self.drop: Dict[Tuple[int, int], int] = {}
@@ -38,6 +40,18 @@ class LoopbackHub:
                  query_handler: Callable) -> None:
         self.subscribers.setdefault(dc_id, [])
         self.query_handlers[dc_id] = query_handler
+
+    def register_request(self, dc_id: int, handler: Callable) -> None:
+        """Attach a generic request handler ((kind, payload) -> reply) —
+        the other message types of the REQ/XREP channel
+        (?BCOUNTER_REQUEST / ?CHECK_UP_MSG,
+        /root/reference/include/antidote_message_types.hrl:4-25)."""
+        self.request_handlers[dc_id] = handler
+
+    def request(self, target_dc: int, kind: str, payload) -> object:
+        """Synchronous cross-DC RPC (inter_dc_query:perform_request,
+        /root/reference/src/inter_dc_query.erl:76-79)."""
+        return self.request_handlers[target_dc](kind, payload)
 
     def subscribe(self, subscriber_dc: int, publisher_dc: int,
                   on_message: Callable[[bytes], None]) -> None:

@@ -67,6 +67,12 @@ class LogManager:
         })
         return opid
 
+    def set_sync(self, sync: bool) -> None:
+        """Runtime fsync-on-commit toggle (logging_vnode:set_sync_log,
+        /root/reference/src/logging_vnode.erl:256-258)."""
+        for w in self.wals:
+            w.set_sync(sync)
+
     def commit_barrier(self, shards) -> None:
         for p in set(int(s) for s in shards):
             self.wals[p].commit()

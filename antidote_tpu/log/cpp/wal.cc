@@ -142,6 +142,13 @@ int wal_commit(void* handle) {
   return 0;
 }
 
+// Runtime toggle for fsync-on-commit (replicated sync_log flag flips,
+// logging_vnode:set_sync_log).
+void wal_set_sync(void* handle, int sync_on_commit) {
+  Wal* w = static_cast<Wal*>(handle);
+  w->sync_on_commit = sync_on_commit != 0;
+}
+
 int wal_sync(void* handle) {
   Wal* w = static_cast<Wal*>(handle);
   if (::fdatasync(w->fd) != 0) return -1;

@@ -55,6 +55,8 @@ def _load_lib():
         lib.wal_commit.argtypes = [ctypes.c_void_p]
         lib.wal_sync.restype = ctypes.c_int
         lib.wal_sync.argtypes = [ctypes.c_void_p]
+        lib.wal_set_sync.restype = None
+        lib.wal_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.wal_close.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception:
@@ -95,6 +97,12 @@ class ShardWAL:
             self._f.write(_HDR.pack(_MAGIC, len(payload),
                                     zlib.crc32(payload) & 0xFFFFFFFF))
             self._f.write(payload)
+
+    def set_sync(self, sync: bool) -> None:
+        """Runtime fsync-on-commit toggle, honored by both backends."""
+        self.sync_on_commit = sync
+        if self._h is not None:
+            self._lib.wal_set_sync(self._h, int(sync))
 
     def commit(self) -> None:
         if self._h is not None:

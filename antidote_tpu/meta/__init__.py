@@ -1,0 +1,11 @@
+"""Cluster & DC metadata (SURVEY §2.6).
+
+``stable_meta_data_server`` re-provided: durable node-local KV with
+DC-wide broadcast and merge-broadcast, env mirroring, and replicated
+runtime flags (/root/reference/src/stable_meta_data_server.erl,
+/root/reference/src/dc_meta_data_utilities.erl).
+"""
+
+from antidote_tpu.meta.stable_meta import MetaDataStore, MetaCluster
+
+__all__ = ["MetaDataStore", "MetaCluster"]

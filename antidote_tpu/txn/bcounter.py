@@ -1,0 +1,133 @@
+"""Bounded-counter (escrow) manager.
+
+The rebuild of ``bcounter_mgr`` (/root/reference/src/bcounter_mgr.erl):
+decrements on ``counter_b`` objects are guarded against the replica's
+locally-held rights (:80-97); failed decrements are queued and the manager
+periodically asks richer DCs for a rights transfer over the inter-DC query
+channel (:131-146), throttled per (key, target) by a grace period
+(?GRACE_PERIOD / ?TRANSFER_FREQ, /root/reference/include/antidote.hrl:73-79).
+The receiving side answers a transfer request by committing a
+``("transfer", ...)`` update if it holds enough rights (:100-101).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+#: seconds a (key, target) pair is throttled after a transfer request
+#: (?GRACE_PERIOD in the reference is 1 s)
+GRACE_PERIOD = 1.0
+#: period of the background transfer loop (?TRANSFER_FREQ 100 ms)
+TRANSFER_FREQ = 0.1
+
+QueueKey = Tuple[Any, str]  # (key, bucket)
+
+
+class NoPermissionsError(Exception):
+    """Decrement exceeds locally-held rights ({error, no_permissions})."""
+
+    def __init__(self, key, needed: int, held: int):
+        super().__init__(
+            f"insufficient rights for {key!r}: need {needed}, hold {held}"
+        )
+        self.key = key
+        self.needed = needed
+        self.held = held
+
+
+class BCounterManager:
+    def __init__(self, my_dc: int, clock: Callable[[], float] = time.monotonic):
+        self.my_dc = my_dc
+        self.clock = clock
+        #: failed decrements awaiting rights: (key, bucket) -> rights NEEDED
+        #: (the full decrement amount; the tick re-derives the shortfall
+        #: from currently-held rights so arrived grants retire the entry)
+        self.pending: Dict[QueueKey, int] = {}
+        #: throttle map: ((key, bucket), target_dc) -> last request time
+        self._last_request: Dict[Tuple[QueueKey, int], float] = {}
+        #: wired by the inter-DC layer: (target_dc, key, bucket, amount) -> None
+        self.request_transfer: Optional[Callable[[int, Any, str, int], None]] = None
+
+    # ------------------------------------------------------------------
+    # decrement guard (generate_downstream, bcounter_mgr.erl:80-97)
+    # ------------------------------------------------------------------
+    def check_decrement(self, ty, state, key, bucket: str, amount: int) -> None:
+        """Raise NoPermissionsError (and queue a transfer request) if this
+        replica does not hold ``amount`` rights for the object."""
+        held = ty.local_rights(state, self.my_dc)
+        if held < amount:
+            qk = (key, bucket)
+            self.pending[qk] = max(self.pending.get(qk, 0), amount)
+            raise NoPermissionsError(key, amount, held)
+
+    # ------------------------------------------------------------------
+    # requester side (transfer_periodic, bcounter_mgr.erl:131-146)
+    # ------------------------------------------------------------------
+    def transfer_periodic(self, read_state: Callable[[Any, str], dict],
+                          ty) -> int:
+        """For each queued shortfall, ask the remote DCs holding the most
+        rights.  ``read_state`` returns the current counter_b state fields.
+        Returns the number of requests sent."""
+        if self.request_transfer is None or not self.pending:
+            return 0
+        import numpy as np
+
+        sent = 0
+        now = self.clock()
+        for (key, bucket), needed in list(self.pending.items()):
+            state = read_state(key, bucket)
+            held = ty.local_rights(state, self.my_dc)
+            shortfall = needed - max(held, 0)
+            if shortfall <= 0:
+                # grants arrived: the queued decrement is now coverable
+                del self.pending[(key, bucket)]
+                continue
+            d = np.asarray(state["used"]).shape[0]
+            rights_by_dc = sorted(
+                ((ty.local_rights(state, dc), dc) for dc in range(d)
+                 if dc != self.my_dc),
+                reverse=True,
+            )
+            remaining = shortfall
+            for rights, dc in rights_by_dc:
+                if rights <= 0 or remaining <= 0:
+                    break
+                tk = ((key, bucket), dc)
+                if now - self._last_request.get(tk, -1e9) < GRACE_PERIOD:
+                    continue
+                ask = min(remaining, rights)
+                self._last_request[tk] = now
+                self.request_transfer(dc, key, bucket, ask)
+                remaining -= ask
+                sent += 1
+        return sent
+
+    def satisfied(self, key, bucket: str) -> None:
+        """Drop the queue entry once rights arrived (caller observed a
+        successful decrement or sufficient local rights)."""
+        self.pending.pop((key, bucket), None)
+
+    # ------------------------------------------------------------------
+    # granter side (process_transfer, bcounter_mgr.erl:100-101)
+    # ------------------------------------------------------------------
+    def process_transfer(self, txm, key, bucket: str, amount: int,
+                         to_dc: int) -> int:
+        """Grant up to ``amount`` rights to ``to_dc`` by committing a
+        transfer update; grants only what this replica holds.  Returns the
+        granted amount (0 = refused)."""
+        from antidote_tpu.crdt import get_type
+
+        ty = get_type("counter_b")
+        state = txm.store.read_states(
+            [(key, "counter_b", bucket)], txm.store.dc_max_vc()
+        )[0]
+        held = ty.local_rights(state, self.my_dc)
+        grant = min(amount, held)
+        if grant <= 0:
+            return 0
+        txm.update_objects_static([
+            (key, "counter_b", bucket,
+             ("transfer", (grant, to_dc, self.my_dc))),
+        ])
+        return grant

@@ -37,6 +37,7 @@ from antidote_tpu.clock import vector as vcm
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import get_type, is_type
 from antidote_tpu.store.kv import BoundObject, Effect, KVStore
+from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
 from antidote_tpu.txn.hooks import HookRegistry
 
 Update = Tuple[Any, str, str, Tuple[str, Any]]  # (key, type_name, bucket, op)
@@ -63,16 +64,24 @@ class Transaction:
 class TransactionManager:
     """One per replica process — owns the commit stream for ``my_dc``."""
 
-    def __init__(self, store: KVStore, my_dc: int = 0, cert: bool = True):
+    def __init__(self, store: KVStore, my_dc: int = 0, cert: bool = True,
+                 protocol: str = "clocksi"):
         self.store = store
         self.cfg: AntidoteConfig = store.cfg
         self.my_dc = my_dc
         #: txn_cert app-env flag (/root/reference/src/antidote.app.src:31-35)
         self.cert = cert
+        #: txn_prot app-env flag: "clocksi" (Cure, full-VC snapshots) or
+        #: "gr" (GentleRain: scalar global-stable-time snapshots —
+        #: cure:gr_snapshot_obtain, /root/reference/src/cure.erl:234-257)
+        assert protocol in ("clocksi", "gr"), protocol
+        self.protocol = protocol
         self.commit_counter = 0
         #: (key, bucket) -> my-lane counter of its last local commit
         self.committed_keys: Dict[Tuple[Any, str], int] = {}
         self.hooks = HookRegistry()
+        #: escrow guard for counter_b (bcounter_mgr, SURVEY §2.5)
+        self.bcounters = BCounterManager(my_dc)
         #: called with (effects, commit_vc, origin) after every local commit
         #: — the inter-DC egress seam (inter_dc_log_sender_vnode:send,
         #: /root/reference/src/inter_dc_log_sender_vnode.erl:80-81)
@@ -92,9 +101,18 @@ class TransactionManager:
     def _snapshot_vc(self) -> np.ndarray:
         """Txn snapshot: remote lanes from the DC stable snapshot (safe —
         every shard has applied at least this much), own lane from the
-        commit counter (local commits apply synchronously)."""
+        commit counter (local commits apply synchronously).
+
+        GentleRain mode replaces the vector with the scalar GST — the min
+        entry across lanes (get_scalar_stable_time,
+        /root/reference/src/dc_utilities.erl:294-317) — trading snapshot
+        freshness for O(1) clock metadata, exactly the gr trade-off."""
         snap = self.store.stable_vc().copy()
         snap[self.my_dc] = self.commit_counter
+        if self.protocol == "gr":
+            gst = int(snap.min())
+            snap = np.full_like(snap, gst)
+            snap[self.my_dc] = self.commit_counter
         return snap
 
     def start_transaction(
@@ -210,11 +228,39 @@ class TransactionManager:
             ):
                 self._apply_update(sub, txn)
             return
+        guarded_b = type_name == "counter_b" and op[0] in ("decrement",
+                                                           "transfer")
         state = None
-        if ty.require_state_downstream(op):
+        if ty.require_state_downstream(op) or guarded_b:
             state = self._read_states_with_overlay(
                 [(key, type_name, bucket)], txn
             )[0]
+        # escrow guard: counter_b decrements and outgoing transfers must be
+        # covered by locally held rights, and must act on THIS replica's
+        # lane — any other lane would spend rights this replica does not
+        # own (clocksi_downstream routes the bounded counter through
+        # bcounter_mgr, /root/reference/src/clocksi_downstream.erl:38-68)
+        if guarded_b:
+            if op[0] == "decrement":
+                amount, lane = op[1]
+                src_lane = lane
+            else:
+                amount, _to_dc, src_lane = op[1]
+            if src_lane != self.my_dc:
+                self._mark_aborted(txn)
+                raise AbortError(
+                    f"counter_b {op[0]} must spend this replica's lane "
+                    f"{self.my_dc}, not {src_lane}"
+                )
+            try:
+                self.bcounters.check_decrement(ty, state, key, bucket, amount)
+            except NoPermissionsError as e:
+                if op[0] == "transfer":
+                    # transfers are not retried by the rights loop
+                    self.bcounters.satisfied(key, bucket)
+                self._mark_aborted(txn)
+                raise AbortError(str(e)) from e
+            self.bcounters.satisfied(key, bucket)
         for eff_a, eff_b, blob_refs in ty.downstream(
             op, state, self.store.blobs, self.cfg
         ):

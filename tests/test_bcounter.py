@@ -1,0 +1,184 @@
+"""Bounded-counter escrow manager — the bcountermgr_SUITE analogue
+(/root/reference/test/multidc/bcountermgr_SUITE.erl): decrement guard,
+queued transfer requests from richer DCs, grace-period throttling, and the
+granter side committing transfer updates that replicate back."""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.interdc import DCReplica, LoopbackHub
+from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
+from antidote_tpu.txn.manager import AbortError
+
+
+@pytest.fixture
+def cfg():
+    return AntidoteConfig(
+        n_shards=2, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=4, keys_per_table=16, batch_buckets=(8,),
+    )
+
+
+@pytest.fixture
+def dcs(cfg):
+    hub = LoopbackHub()
+    nodes = [AntidoteNode(cfg, dc_id=i) for i in range(3)]
+    reps = [DCReplica(n, hub, f"dc{i}") for i, n in enumerate(nodes)]
+    DCReplica.connect_all(reps)
+    return hub, nodes, reps
+
+
+def test_decrement_within_rights(dcs):
+    hub, nodes, _ = dcs
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
+    nodes[0].update_objects([("c", "counter_b", "b", ("decrement", (4, 0)))])
+    vals, _ = nodes[0].read_objects([("c", "counter_b", "b")])
+    assert vals[0] == 6
+
+
+def test_decrement_beyond_rights_aborts(dcs):
+    hub, nodes, _ = dcs
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (3, 0)))])
+    with pytest.raises(AbortError, match="insufficient rights"):
+        nodes[0].update_objects([("c", "counter_b", "b", ("decrement", (5, 0)))])
+    # value untouched; the needed amount is queued for the transfer loop
+    vals, _ = nodes[0].read_objects([("c", "counter_b", "b")])
+    assert vals[0] == 3
+    assert nodes[0].txm.bcounters.pending == {("c", "b"): 5}
+
+
+def test_transfer_loop_moves_rights_between_dcs(dcs):
+    """DC1 cannot decrement until DC0 grants rights via the query channel
+    (the new_dc / transfer flow of bcountermgr_SUITE)."""
+    hub, nodes, reps = dcs
+    vc = nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    # DC1 sees the value but holds no rights
+    vals, _ = nodes[1].read_objects([("c", "counter_b", "b")], clock=vc)
+    assert vals[0] == 10
+    with pytest.raises(AbortError):
+        nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
+    # transfer loop: DC1 asks DC0 (the richest lane); DC0 commits a
+    # transfer; replication delivers it back to DC1
+    sent = reps[1].bcounter_tick()
+    assert sent == 1
+    hub.pump()
+    nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
+    hub.pump()
+    for n in nodes:
+        vals, _ = n.read_objects([("c", "counter_b", "b")],
+                                 clock=nodes[1].txm.store.dc_max_vc())
+        assert vals[0] == 6
+    assert nodes[1].txm.bcounters.pending == {}
+
+
+def test_transfer_request_throttled_by_grace_period(dcs):
+    hub, nodes, reps = dcs
+    t = [0.0]
+    nodes[1].txm.bcounters.clock = lambda: t[0]
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    with pytest.raises(AbortError):
+        nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (20, 1)))])
+    # drop the granted transfer so the shortfall persists
+    hub.drop_next(0, 1, n=10)
+    assert reps[1].bcounter_tick() == 1
+    hub.pump()
+    # same instant: throttled, no second request
+    with pytest.raises(AbortError):
+        nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (20, 1)))])
+    assert reps[1].bcounter_tick() == 0
+    # after the grace period the request is retried
+    t[0] += 2.0
+    assert reps[1].bcounter_tick() >= 1
+
+
+def test_granter_refuses_when_broke(dcs):
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (2, 0)))])
+    hub.pump()
+    granted = nodes[0].txm.bcounters.process_transfer(
+        nodes[0].txm, "c", "b", 5, 1
+    )
+    assert granted == 2  # grants only what it holds
+    granted = nodes[2].txm.bcounters.process_transfer(
+        nodes[2].txm, "c", "b", 5, 1
+    )
+    assert granted == 0  # DC2 holds nothing
+
+
+def test_foreign_lane_decrement_rejected(dcs):
+    """A decrement naming another replica's lane would spend rights this
+    replica does not own — must abort even if that lane is rich."""
+    hub, nodes, _ = dcs
+    vc = nodes[0].update_objects([("c", "counter_b", "b", ("increment", (9, 0)))])
+    hub.pump()
+    with pytest.raises(AbortError, match="lane"):
+        nodes[1].update_objects(
+            [("c", "counter_b", "b", ("decrement", (1, 0)))], clock=vc
+        )
+
+
+def test_client_transfer_requires_local_rights(dcs):
+    """A client-issued transfer must originate at the owning replica and
+    be covered by its rights — otherwise DC1 could steal DC0's escrow."""
+    hub, nodes, _ = dcs
+    vc = nodes[0].update_objects([("c", "counter_b", "b", ("increment", (5, 0)))])
+    hub.pump()
+    # theft attempt: DC1 names DC0 as the source
+    with pytest.raises(AbortError, match="lane"):
+        nodes[1].update_objects(
+            [("c", "counter_b", "b", ("transfer", (5, 1, 0)))], clock=vc
+        )
+    # over-transfer from own (empty) lane
+    with pytest.raises(AbortError, match="insufficient rights"):
+        nodes[1].update_objects(
+            [("c", "counter_b", "b", ("transfer", (1, 0, 1)))], clock=vc
+        )
+    # legitimate transfer from the owner works
+    nodes[0].update_objects([("c", "counter_b", "b", ("transfer", (2, 1, 0)))])
+    hub.pump()
+    nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (2, 1)))])
+
+
+def test_transfer_queue_retires_when_rights_arrive(dcs):
+    """Once grants land, the tick drops the queue entry instead of
+    re-requesting forever (abandoned-client scenario)."""
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    with pytest.raises(AbortError):
+        nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
+    assert reps[1].bcounter_tick() == 1   # request sent, grant replicates
+    hub.pump()
+    # client never retries; the next tick sees the rights and retires the
+    # entry without another request
+    assert reps[1].bcounter_tick() == 0
+    assert nodes[1].txm.bcounters.pending == {}
+
+
+def test_concurrent_decrements_never_go_negative(dcs):
+    """Escrow safety: both DCs decrement concurrently from their own
+    rights; the merged value stays ≥ 0."""
+    hub, nodes, reps = dcs
+    vc = nodes[0].update_objects([
+        ("c", "counter_b", "b", ("increment", (6, 0))),
+        ("c", "counter_b", "b", ("transfer", (3, 1, 0))),
+    ])
+    hub.pump()
+    nodes[0].update_objects([("c", "counter_b", "b", ("decrement", (3, 0)))])
+    nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (3, 1)))],
+                            clock=vc)
+    hub.pump()
+    for n in nodes:
+        vals, _ = n.read_objects([("c", "counter_b", "b")],
+                                 clock=n.txm.store.dc_max_vc())
+        assert vals[0] == 0
+    # both replicas are now dry: further decrements abort everywhere
+    for i in (0, 1):
+        with pytest.raises(AbortError):
+            nodes[i].update_objects(
+                [("c", "counter_b", "b", ("decrement", (1, i)))]
+            )

@@ -1,0 +1,120 @@
+"""Stable metadata store: durable KV, DC broadcast, merge-broadcast,
+env mirroring, replicated runtime flags — mirroring
+stable_meta_data_server + dc_meta_data_utilities (SURVEY §2.6)."""
+
+import os
+
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.meta import MetaCluster, MetaDataStore
+
+
+def test_local_put_get_and_persistence(tmp_path):
+    p = str(tmp_path / "meta.bin")
+    s = MetaDataStore(path=p)
+    s.put("dc_id", 3)
+    s.put("descriptors", [[0, "dc0", 8], [1, "dc1", 8]])
+    # restart: reload from disk (recover_meta_data_on_start)
+    s2 = MetaDataStore(path=p)
+    assert s2.get("dc_id") == 3
+    assert s2.get("descriptors") == [[0, "dc0", 8], [1, "dc1", 8]]
+
+
+def test_atomic_persist_no_torn_file(tmp_path):
+    p = str(tmp_path / "meta.bin")
+    s = MetaDataStore(path=p)
+    for i in range(50):
+        s.put(f"k{i}", "x" * 100)
+    assert MetaDataStore(path=p).get("k49") == "x" * 100
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_cluster_broadcast_reaches_all_nodes(tmp_path):
+    cluster = MetaCluster()
+    stores = [MetaDataStore(path=str(tmp_path / f"n{i}.bin"), node_id=i)
+              for i in range(3)]
+    for s in stores:
+        cluster.join(s)
+    stores[0].put("flag", True)
+    assert all(s.get("flag") is True for s in stores)
+    # survives each node's restart independently
+    assert MetaDataStore(path=str(tmp_path / "n2.bin")).get("flag") is True
+
+
+def test_merge_broadcast():
+    cluster = MetaCluster()
+    stores = [MetaDataStore(node_id=i) for i in range(2)]
+    for s in stores:
+        cluster.join(s)
+    merge = lambda new, cur: sorted(set(cur) | {new})
+    out = stores[0].put_merge("members", 5, merge, default=[])
+    assert out == [5]
+    out = stores[1].put_merge("members", 2, merge, default=[])
+    assert out == [2, 5]
+    assert stores[0].get("members") == [2, 5]
+
+
+def test_late_joiner_catches_up():
+    cluster = MetaCluster()
+    a = MetaDataStore(node_id=0)
+    cluster.join(a)
+    a.put("seed", 42)
+    b = MetaDataStore(node_id=1)
+    cluster.join(b)
+    assert b.get("seed") == 42
+
+
+def test_env_mirroring(monkeypatch):
+    monkeypatch.setenv("ANTIDOTE_TXN_CERT", "false")
+    s = MetaDataStore()
+    assert s.get_env("txn_cert", True) is False
+    # first lookup seeds the replicated table: later env changes don't flip it
+    monkeypatch.setenv("ANTIDOTE_TXN_CERT", "true")
+    assert s.get_env("txn_cert", True) is False
+
+
+def test_env_default_and_parse(monkeypatch):
+    monkeypatch.delenv("ANTIDOTE_MISSING", raising=False)
+    s = MetaDataStore()
+    assert s.get_env("missing", 7) == 7
+    monkeypatch.setenv("ANTIDOTE_NUM", "123")
+    assert s.get_env("num") == 123
+
+
+def test_sync_log_flip_reaches_other_live_nodes(tmp_path):
+    """Flipping the flag on one node must apply to every member node's
+    RUNNING log via the meta watcher, not only at restart."""
+    cfg = AntidoteConfig(
+        n_shards=2, max_dcs=2, ops_per_key=4, snap_versions=2,
+        set_slots=4, keys_per_table=16, batch_buckets=(8,),
+    )
+    cluster = MetaCluster()
+    metas = [MetaDataStore(node_id=i) for i in range(2)]
+    nodes = [
+        AntidoteNode(cfg, log_dir=str(tmp_path / f"wal{i}"), meta=metas[i])
+        for i in range(2)
+    ]
+    for m in metas:
+        cluster.join(m)
+    nodes[0].set_sync_log(True)
+    assert all(w.sync_on_commit for w in nodes[1].store.log.wals)
+    nodes[1].set_sync_log(False)
+    assert not any(w.sync_on_commit for w in nodes[0].store.log.wals)
+
+
+def test_sync_log_replicated_flag(tmp_path):
+    cfg = AntidoteConfig(
+        n_shards=2, max_dcs=2, ops_per_key=4, snap_versions=2,
+        set_slots=4, keys_per_table=16, batch_buckets=(8,),
+    )
+    node = AntidoteNode(cfg, log_dir=str(tmp_path / "wal"))
+    assert node.store.log.wals[0].sync_on_commit is False
+    node.set_sync_log(True)
+    assert node.meta.get_env("sync_log") is True
+    assert all(w.sync_on_commit for w in node.store.log.wals)
+    # committing with sync on still works end-to-end
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    vals, _ = node.read_objects([("k", "counter_pn", "b")])
+    assert vals[0] == 1
