@@ -39,6 +39,11 @@ class CRDTType(abc.ABC):
     name: str
     #: stable small integer id (used in logs and wire format)
     type_id: int
+    #: True when the fold is an associative+commutative monoid: the type
+    #: also provides delta_of_ops/delta_merge/delta_apply, letting long op
+    #: logs reduce in O(log L) depth and partial folds merge across
+    #: devices (materializer/longlog.py; SURVEY §2.10 last row)
+    supports_assoc: bool = False
 
     # ---- host side ----------------------------------------------------
 

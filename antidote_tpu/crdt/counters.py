@@ -28,9 +28,20 @@ class CounterPN(CRDTType):
 
     name = "counter_pn"
     type_id = 1
+    supports_assoc = True
 
     def state_spec(self, cfg):
         return {"cnt": ((), jnp.int64)}
+
+    # -- associative fold (sums commute; SURVEY §2.10 last row) ---------
+    def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
+        return {"cnt": jnp.sum(jnp.where(mask, ops_a[:, 0], 0))}
+
+    def delta_merge(self, a, b):
+        return {"cnt": a["cnt"] + b["cnt"]}
+
+    def delta_apply(self, state, d):
+        return {"cnt": state["cnt"] + d["cnt"]}
 
     def is_operation(self, op):
         kind, arg = op

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,9 +41,36 @@ class _FlagBase(CRDTType):
         return (np.zeros((1,), dtype=np.int64), b, [])
 
 
-class FlagEW(_FlagBase):
+class _FlagAssocMixin:
+    """Both flags fold by elementwise clock max — an associative,
+    commutative monoid, so long op logs reduce in O(log L) depth and
+    partial folds merge across devices (SURVEY §2.10 last row)."""
+
+    supports_assoc = True
+
+    def delta_merge(self, a, b):
+        return {
+            "envc": jnp.maximum(a["envc"], b["envc"]),
+            "disvc": jnp.maximum(a["disvc"], b["disvc"]),
+        }
+
+    def delta_apply(self, state, d):
+        return self.delta_merge(state, d)
+
+
+class FlagEW(_FlagAssocMixin, _FlagBase):
     name = "flag_ew"
     type_id = 9
+
+    def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
+        d = cfg.max_dcs
+        kind = ops_b[:, 0]
+        obs = ops_b[:, 1:1 + d]
+        onehot = jax.nn.one_hot(ops_origin, d, dtype=ops_vc.dtype)
+        own = jnp.take_along_axis(ops_vc, ops_origin[:, None], axis=1)
+        en = jnp.where((mask & (kind == _ENABLE))[:, None], onehot * own, 0)
+        dis = jnp.where((mask & (kind != _ENABLE))[:, None], obs, 0)
+        return {"envc": jnp.max(en, axis=0), "disvc": jnp.max(dis, axis=0)}
 
     def require_state_downstream(self, op):
         return op[0] in ("disable", "reset")
@@ -70,9 +98,20 @@ class FlagEW(_FlagBase):
         }
 
 
-class FlagDW(_FlagBase):
+class FlagDW(_FlagAssocMixin, _FlagBase):
     name = "flag_dw"
     type_id = 10
+
+    def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
+        d = cfg.max_dcs
+        kind = ops_b[:, 0]
+        obs = ops_b[:, 1:1 + d]
+        onehot = jax.nn.one_hot(ops_origin, d, dtype=ops_vc.dtype)
+        own = jnp.take_along_axis(ops_vc, ops_origin[:, None], axis=1)
+        en_m = (mask & (kind == _ENABLE))[:, None]
+        en = jnp.where(en_m, jnp.maximum(obs, onehot * own), 0)
+        dis = jnp.where((mask & (kind != _ENABLE))[:, None], onehot * own, 0)
+        return {"envc": jnp.max(en, axis=0), "disvc": jnp.max(dis, axis=0)}
 
     def require_state_downstream(self, op):
         return op[0] == "enable"
