@@ -1,0 +1,295 @@
+"""TCP inter-DC fabric — real sockets between replicas.
+
+The reference's two inter-DC channels (SURVEY §5) were ZeroMQ sockets:
+PUB/SUB for the txn stream (port 8086, /root/reference/src/inter_dc_pub.erl)
+and REQ/XREP for log catch-up + bcounter transfers (port 8085,
+/root/reference/src/inter_dc_query.erl).  ``TcpFabric`` reproduces both
+over plain TCP with the same length-prefixed framing as the client
+protocol: each DC runs one endpoint socket; peers open one connection for
+the subscription stream (server pushes frames) and one for synchronous
+queries.
+
+Interface-compatible with ``LoopbackHub``: incoming stream messages are
+queued and delivered on ``pump()`` so replica state is only touched from
+the control thread; query/request handlers run on server threads under the
+DC's handler lock (the same single-writer discipline the vnode processes
+gave the reference).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+_HDR = struct.Struct(">IB")
+K_SUB, K_PUSH, K_LOGQ, K_REQ, K_REPLY, K_ERR = 1, 2, 3, 4, 5, 6
+
+
+def _send(sock, kind: int, body) -> None:
+    payload = msgpack.packb(body, use_bin_type=True)
+    sock.sendall(_HDR.pack(len(payload) + 1, kind) + payload)
+
+
+def _recv(sock) -> Tuple[int, object]:
+    hdr = _read_exact(sock, _HDR.size)
+    n, kind = _HDR.unpack(hdr)
+    payload = _read_exact(sock, n - 1)
+    return kind, msgpack.unpackb(payload, raw=False, strict_map_key=False)
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Endpoint:
+    """One DC's listening side: accepts subscriber streams and queries."""
+
+    def __init__(self, fabric: "TcpFabric", dc_id: int, host: str, port: int):
+        self.fabric = fabric
+        self.dc_id = dc_id
+        self.lock = threading.RLock()          # guards handler invocations
+        self.query_handler: Optional[Callable] = None
+        self.request_handler: Optional[Callable] = None
+        self._subs: List[socket.socket] = []
+        self._subs_lock = threading.Lock()
+        ep = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    kind, body = _recv(self.request)
+                except (ConnectionError, OSError):
+                    return
+                if kind == K_SUB:
+                    # this connection becomes a push stream; hold it open
+                    with ep._subs_lock:
+                        ep._subs.append(self.request)
+                    # park until the peer closes (reads detect EOF)
+                    try:
+                        while self.request.recv(1):
+                            pass
+                    except OSError:
+                        pass
+                    with ep._subs_lock:
+                        if self.request in ep._subs:
+                            ep._subs.remove(self.request)
+                    return
+                # query connection: serve request/reply until EOF
+                while True:
+                    try:
+                        reply = ep._serve(kind, body)
+                        _send(self.request, K_REPLY, reply)
+                        kind, body = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    except Exception as e:
+                        try:
+                            _send(self.request, K_ERR, repr(e))
+                            kind, body = _recv(self.request)
+                        except (ConnectionError, OSError):
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"interdc:{dc_id}:{self.port}",
+        )
+        self._thread.start()
+
+    def _serve(self, kind: int, body):
+        if kind == K_LOGQ:
+            # read-only (scans the replica's sent chain): lock-free, so a
+            # catch-up query from a peer that is itself mid-pump can never
+            # join a cross-DC lock cycle
+            msgs = self.query_handler(
+                body["shard"], body["origin"], body["from"]
+            )
+            return [bytes(m) for m in msgs]
+        if kind == K_REQ:
+            # mutates node state (e.g. a bcounter grant commits a txn):
+            # excluded against this DC's pump by the handler lock
+            with self.lock:
+                return self.request_handler(body["kind"], body["payload"])
+        raise ValueError(f"unknown frame kind {kind}")
+
+    def push(self, data: bytes) -> None:
+        with self._subs_lock:
+            conns = list(self._subs)
+        for c in conns:
+            try:
+                _send(c, K_PUSH, data)
+            except OSError:
+                with self._subs_lock:
+                    if c in self._subs:
+                        self._subs.remove(c)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        with self._subs_lock:
+            for c in self._subs:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._subs.clear()
+
+
+class TcpFabric:
+    """LoopbackHub-compatible transport over real sockets.
+
+    In-process it behaves like the hub (tests run 2-3 DCs on localhost);
+    across processes, exchange ``address_of`` endpoints via descriptors and
+    call ``connect_remote`` (the descriptor exchange of
+    inter_dc_manager:observe_dcs_sync,
+    /root/reference/src/inter_dc_manager.erl:67-109).
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.endpoints: Dict[int, _Endpoint] = {}
+        #: dc_id -> (host, port) for remote DCs
+        self.addresses: Dict[int, Tuple[str, int]] = {}
+        #: subscriber-side inbox: (on_message, data) pairs await pump()
+        self.inbox: "queue.Queue" = queue.Queue()
+        self._readers: List[threading.Thread] = []
+        self._query_conns: Dict[Tuple[int, int], socket.socket] = {}
+        self._query_lock = threading.Lock()
+        self.delivered = 0
+
+    # -- LoopbackHub interface -----------------------------------------
+    def register(self, dc_id: int, on_message, query_handler) -> None:
+        ep = _Endpoint(self, dc_id, self.host, 0)
+        ep.query_handler = query_handler
+        self.endpoints[dc_id] = ep
+        self.addresses[dc_id] = (ep.host, ep.port)
+
+    def register_request(self, dc_id: int, handler) -> None:
+        self.endpoints[dc_id].request_handler = handler
+
+    def address_of(self, dc_id: int) -> Tuple[str, int]:
+        return self.addresses[dc_id]
+
+    def connect_remote(self, dc_id: int, host: str, port: int) -> None:
+        """Learn a remote (possibly other-process) DC's endpoint."""
+        self.addresses[dc_id] = (host, port)
+
+    def subscribe(self, subscriber_dc: int, publisher_dc: int,
+                  on_message) -> None:
+        host, port = self.addresses[publisher_dc]
+        sock = socket.create_connection((host, port))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send(sock, K_SUB, subscriber_dc)
+
+        def reader():
+            try:
+                while True:
+                    kind, body = _recv(sock)
+                    if kind == K_PUSH:
+                        self.inbox.put((on_message, bytes(body)))
+            except (ConnectionError, OSError):
+                return
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name=f"sub:{subscriber_dc}<-{publisher_dc}")
+        t.start()
+        self._readers.append(t)
+
+    def publish(self, from_dc: int, data: bytes) -> None:
+        self.endpoints[from_dc].push(data)
+
+    def _rpc(self, target_dc: int, kind: int, body):
+        with self._query_lock:
+            key = (threading.get_ident(), target_dc)
+            sock = self._query_conns.get(key)
+            if sock is None:
+                host, port = self.addresses[target_dc]
+                sock = socket.create_connection((host, port))
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._query_conns[key] = sock
+        _send(sock, kind, body)
+        rkind, reply = _recv(sock)
+        if rkind == K_ERR:
+            raise RuntimeError(f"remote error from dc{target_dc}: {reply}")
+        return reply
+
+    def query_log(self, target_dc: int, shard: int, origin: int,
+                  from_opid: int) -> List[bytes]:
+        return [bytes(m) for m in self._rpc(
+            target_dc, K_LOGQ,
+            {"shard": shard, "origin": origin, "from": from_opid},
+        )]
+
+    def request(self, target_dc: int, kind: str, payload):
+        return self._rpc(target_dc, K_REQ,
+                         {"kind": kind, "payload": payload})
+
+    def pump(self, max_rounds: int = 100_000, timeout: float = 0.5) -> int:
+        """Deliver queued stream messages on the calling thread until the
+        fabric is quiescent for ``timeout`` seconds."""
+        n = 0
+        while n < max_rounds:
+            try:
+                cb, data = self.inbox.get(timeout=timeout)
+            except queue.Empty:
+                return n
+            # take the local handler locks so server threads (queries,
+            # bcounter grants) never interleave with gate processing
+            with self._local_locks():
+                cb(data)
+            self.delivered += 1
+            n += 1
+        return n
+
+    def _local_locks(self):
+        """A context manager holding every local endpoint's handler lock."""
+        eps = list(self.endpoints.values())
+
+        class _Multi:
+            def __enter__(self):
+                for e in eps:
+                    e.lock.acquire()
+
+            def __exit__(self, *exc):
+                for e in reversed(eps):
+                    e.lock.release()
+                return False
+
+        return _Multi()
+
+    @staticmethod
+    def interconnect(fabrics: List["TcpFabric"]) -> None:
+        """Share endpoint addresses between per-DC fabrics (the in-process
+        stand-in for exchanging descriptors between deployments)."""
+        for a in fabrics:
+            for b in fabrics:
+                for dc, addr in b.addresses.items():
+                    a.addresses.setdefault(dc, addr)
+
+    def close(self) -> None:
+        for ep in self.endpoints.values():
+            ep.close()
+        with self._query_lock:
+            for s in self._query_conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._query_conns.clear()
