@@ -12,7 +12,6 @@ like Antidote's ``{Key, Type, Bucket}`` bound objects.
 
 from __future__ import annotations
 
-import hashlib
 from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -35,13 +34,11 @@ def freeze_key(key: Any) -> Any:
 
 def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
     """Key→shard map.  Integer keys map directly (mod n_shards), other keys
-    hash — mirroring log_utilities:get_key_partition
+    hash via the native router — mirroring log_utilities:get_key_partition
     (/root/reference/src/log_utilities.erl:75-79,96-118)."""
-    if isinstance(key, int):
-        return key % n_shards
-    data = repr((key, bucket)).encode()
-    h = int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
-    return h % n_shards
+    from antidote_tpu.store.router import shard_of
+
+    return shard_of(key, bucket, n_shards)
 
 
 class Effect:
