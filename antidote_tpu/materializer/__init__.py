@@ -1,3 +1,15 @@
 from antidote_tpu.materializer.fold import fold_batch, fold_key, eager_fold_batch
+from antidote_tpu.materializer.pallas_kernels import (
+    counter_fold,
+    orset_presence,
+    stable_min,
+)
 
-__all__ = ["fold_batch", "fold_key", "eager_fold_batch"]
+__all__ = [
+    "fold_batch",
+    "fold_key",
+    "eager_fold_batch",
+    "counter_fold",
+    "orset_presence",
+    "stable_min",
+]

@@ -1,0 +1,252 @@
+"""Pallas TPU kernels for the materializer hot path.
+
+The generic fold (`fold.fold_batch`) runs the CRDT-specific ``apply`` under
+a ``lax.scan`` — correct for every type, but for the monoid counter family
+the fold is a *masked reduction*, and the stable-snapshot merge is a
+*masked min-reduction* over per-shard clock rows
+(/root/reference/src/stable_time_functions.erl:51-85).  Both are
+bandwidth-bound VPU work with tiny per-element compute, which is exactly
+where a hand-tiled Pallas kernel beats the XLA default: one pass over the
+op ring in VMEM, inclusion mask (the vectorized ``is_op_in_snapshot``,
+/root/reference/src/clocksi_materializer.erl:214-268) fused with the
+reduction, no [B, K] intermediates materialized in HBM.
+
+Kernels fall back to ``interpret=True`` automatically off-TPU so the same
+tests run on the CPU mesh (tests/conftest.py) and on the real chip.
+
+The package enables x64 globally (i64 payload lanes); Mosaic lowering wants
+i32 index arithmetic, so every kernel invocation runs under
+``jax.enable_x64(False)`` — all kernel operands are i32 by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _pad_to(x, mult, axis, fill=0):
+    n = x.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads, constant_values=fill)
+
+
+# ---------------------------------------------------------------------------
+# counter fold: masked sum over the op ring with VC-dominance inclusion
+# ---------------------------------------------------------------------------
+def _counter_fold_kernel(deltas_ref, ops_vc_ref, n_ops_ref, base_vc_ref,
+                         read_vc_ref, cnt_ref, applied_ref):
+    # block shapes: deltas [BLK, K]; ops_vc [D, BLK, K] (lane-transposed so
+    # each per-DC comparison is a clean 2D tile — Mosaic has no minor-dim
+    # bool reduction); n_ops [BLK, 1]; base_vc/read_vc [BLK, D];
+    # outputs [BLK, 1]
+    d = ops_vc_ref.shape[0]
+    v0 = ops_vc_ref[0]                             # [BLK, K]
+    in_base = v0 <= base_vc_ref[:, 0:1]
+    visible = v0 <= read_vc_ref[:, 0:1]
+    for dd in range(1, d):
+        vd = ops_vc_ref[dd]
+        in_base = in_base & (vd <= base_vc_ref[:, dd:dd + 1])
+        visible = visible & (vd <= read_vc_ref[:, dd:dd + 1])
+    slots = jax.lax.broadcasted_iota(jnp.int32, v0.shape, 1)
+    include = (~in_base) & visible & (slots < n_ops_ref[:])  # [BLK, K]
+    cnt_ref[:] = jnp.sum(
+        jnp.where(include, deltas_ref[:], 0), axis=1, keepdims=True
+    )
+    applied_ref[:] = jnp.sum(
+        jnp.where(include, 1, 0), axis=1, keepdims=True
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _counter_fold_call(deltas, ops_vc, n_ops, base_vc, read_vc,
+                       block: int, interpret: bool):
+    b0 = deltas.shape[0]
+    deltas = _pad_to(deltas, block, 0)
+    ops_vc = _pad_to(ops_vc, block, 0)
+    n_ops = _pad_to(n_ops.reshape(-1, 1), block, 0)
+    base_vc = _pad_to(base_vc, block, 0)
+    read_vc = _pad_to(read_vc, block, 0, fill=-1)  # nothing visible in pad
+    b, k = deltas.shape
+    d = ops_vc.shape[-1]
+    ops_vc = jnp.transpose(ops_vc, (2, 0, 1))      # [D, B, K]
+    grid = (b // block,)
+    with jax.enable_x64(False):
+        cnt, applied = _counter_fold_pallas(deltas, ops_vc, n_ops, base_vc,
+                                            read_vc, b, k, d, grid, block,
+                                            interpret)
+    return cnt[:b0, 0], applied[:b0, 0]
+
+
+def _counter_fold_pallas(deltas, ops_vc, n_ops, base_vc, read_vc,
+                         b, k, d, grid, block, interpret):
+    return pl.pallas_call(
+        _counter_fold_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, k), lambda i: (i, 0)),
+            pl.BlockSpec((d, block, k), lambda i: (0, i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(deltas, ops_vc, n_ops, base_vc, read_vc)
+
+
+def counter_fold(base_cnt, deltas, ops_vc, n_ops, base_vc, read_vc,
+                 block: int = 256, interpret: bool | None = None):
+    """Batched counter_pn materialization as one fused Pallas pass.
+
+    ``base_cnt`` i64[B] (snapshot counters), ``deltas`` i32[B, K] (op deltas,
+    lane 0 of ops_a), ``ops_vc`` i32[B, K, D], ``n_ops`` i32[B],
+    ``base_vc``/``read_vc`` i32[B, D].  Returns (cnt i64[B], applied i32[B]).
+
+    Equivalent to ``fold.fold_batch`` for counter_pn whenever the ring-window
+    deltas fit the i32 kernel sum; the running total stays i64.  Deltas whose
+    magnitude could overflow the per-key i32 partial sum (|delta| >
+    ``INT32_MAX // K``) raise ``ValueError`` — fall back to
+    ``fold.fold_batch`` for such workloads rather than wrapping silently.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    deltas = jnp.asarray(deltas)
+    k = max(int(deltas.shape[-1]), 1)
+    peak = int(np.abs(np.asarray(deltas)).max()) if deltas.size else 0
+    if peak > _I32_MAX // k:
+        raise ValueError(
+            f"counter_fold: |delta| up to {peak} could overflow the i32 "
+            f"kernel sum over a {k}-slot ring; use fold.fold_batch for "
+            "this workload"
+        )
+    dcnt, applied = _counter_fold_call(
+        jnp.asarray(deltas, jnp.int32), jnp.asarray(ops_vc, jnp.int32),
+        jnp.asarray(n_ops, jnp.int32), jnp.asarray(base_vc, jnp.int32),
+        jnp.asarray(read_vc, jnp.int32), block, interpret,
+    )
+    return jnp.asarray(base_cnt, jnp.int64) + dcnt.astype(jnp.int64), applied
+
+
+# ---------------------------------------------------------------------------
+# stable-snapshot min: entry-wise min over N clock rows
+# ---------------------------------------------------------------------------
+def _stable_min_kernel(clocks_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.full_like(out_ref, _I32_MAX)
+
+    out_ref[:] = jnp.minimum(
+        out_ref[:], jnp.min(clocks_ref[:], axis=0, keepdims=True)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _stable_min_call(clocks, block: int, interpret: bool):
+    clocks = _pad_to(clocks, block, 0, fill=_I32_MAX)
+    n, d = clocks.shape
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _stable_min_kernel,
+            grid=(n // block,),
+            in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, d), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, d), jnp.int32),
+            interpret=interpret,
+        )(clocks)
+    return out[0]
+
+def stable_min(clocks, block: int = 512, interpret: bool | None = None):
+    """Entry-wise min over ``clocks`` i32[N, D] → i32[D].
+
+    The DC-wide stable snapshot = min over all partitions' applied clocks
+    (/root/reference/src/stable_time_functions.erl:51-85, gossiped once a
+    second there; here one streaming device pass).  Rows with value
+    INT32_MAX (e.g. not-yet-started shards) are identity elements.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    clocks = jnp.asarray(clocks, jnp.int32)
+    if clocks.shape[0] == 0:
+        return jnp.full((clocks.shape[1],), _I32_MAX, jnp.int32)
+    return _stable_min_call(clocks, block, interpret)
+
+
+# ---------------------------------------------------------------------------
+# OR-set presence: fused add/remove dot comparison over gathered head rows
+# ---------------------------------------------------------------------------
+def _presence_kernel(addvc_ref, rmvc_ref, elems_lo_ref, out_ref):
+    # block: addvc/rmvc [D, BLK, E] (lane-transposed); elems_lo [BLK, E]
+    d = addvc_ref.shape[0]
+    present = addvc_ref[0] > rmvc_ref[0]           # [BLK, E]
+    for dd in range(1, d):
+        present = present | (addvc_ref[dd] > rmvc_ref[dd])
+    present = present & (elems_lo_ref[:] != 0)
+    out_ref[:] = jnp.where(present, 1, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _presence_call(addvc, rmvc, elems_lo, block: int, interpret: bool):
+    b0 = addvc.shape[0]
+    addvc = _pad_to(addvc, block, 0)
+    rmvc = _pad_to(rmvc, block, 0)
+    elems_lo = _pad_to(elems_lo, block, 0)
+    b, e, d = addvc.shape
+    addvc = jnp.transpose(addvc, (2, 0, 1))        # [D, B, E]
+    rmvc = jnp.transpose(rmvc, (2, 0, 1))
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _presence_kernel,
+            grid=(b // block,),
+            in_specs=[
+                pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
+                pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
+                pl.BlockSpec((block, e), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((block, e), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, e), jnp.int32),
+            interpret=interpret,
+        )(addvc, rmvc, elems_lo)
+    return out[:b0]
+
+
+def orset_presence(addvc, rmvc, elems_lo, block: int = 256,
+                   interpret: bool | None = None):
+    """OR-set element presence for gathered head rows.
+
+    ``addvc``/``rmvc`` i32[B, E, D] (per-slot add/remove dots), ``elems_lo``
+    i32[B, E] (nonzero ⇔ slot occupied; low 32 bits suffice for the
+    occupancy test).  present ⟺ ∃d: addvc > rmvc — the observed-remove
+    rule of ``antidote_crdt_set_aw`` resolved as one fused comparison.
+    Returns i32[B, E] (0/1).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _presence_call(
+        jnp.asarray(addvc, jnp.int32), jnp.asarray(rmvc, jnp.int32),
+        jnp.asarray(elems_lo, jnp.int32), block, interpret,
+    )

@@ -19,6 +19,7 @@ import numpy as np
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import get_type
 from antidote_tpu.crdt.blob import BlobStore
+from antidote_tpu.store.router import shard_batch, shard_of
 from antidote_tpu.store.typed_table import TypedTable
 
 BoundObject = Tuple[Any, str, str]  # (key, type_name, bucket)
@@ -36,8 +37,6 @@ def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
     """Key→shard map.  Integer keys map directly (mod n_shards), other keys
     hash via the native router — mirroring log_utilities:get_key_partition
     (/root/reference/src/log_utilities.erl:75-79,96-118)."""
-    from antidote_tpu.store.router import shard_of
-
     return shard_of(key, bucket, n_shards)
 
 
@@ -101,6 +100,29 @@ class KVStore:
         self.directory[dk] = ent
         return ent
 
+    def locate_many(self, objects: Sequence[BoundObject]) -> None:
+        """Pre-bind a batch of objects: unseen keys are routed with ONE
+        native ``shard_batch`` FFI crossing (the batched path router.cc is
+        built for), then rows allocated.  Subsequent ``locate`` calls are
+        pure dict hits."""
+        missing = [
+            (key, type_name, bucket)
+            for key, type_name, bucket in objects
+            if (key, bucket) not in self.directory
+        ]
+        if not missing:
+            return
+        shards = shard_batch(
+            [m[0] for m in missing], [m[2] for m in missing],
+            self.cfg.n_shards,
+        )
+        for (key, type_name, bucket), shard in zip(missing, shards):
+            dk = (key, bucket)
+            if dk in self.directory:  # duplicate within the batch
+                continue
+            row = self.table(type_name).alloc_row(int(shard))
+            self.directory[dk] = (type_name, int(shard), int(row))
+
     # ------------------------------------------------------------------
     def apply_effects(
         self,
@@ -117,6 +139,7 @@ class KVStore:
         """
         by_type: Dict[str, list] = {}
         touched = []
+        self.locate_many([(e.key, e.type_name, e.bucket) for e in effects])
         for i, eff in enumerate(effects):
             _, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
             for h, data in eff.blob_refs:
@@ -158,6 +181,7 @@ class KVStore:
         read VC (grouped by type into batched device folds)."""
         read_vc = np.asarray(read_vc, np.int32)
         by_type: Dict[str, list] = {}
+        self.locate_many(objects)
         for i, (key, type_name, bucket) in enumerate(objects):
             _, shard, row = self.locate(key, type_name, bucket)
             by_type.setdefault(type_name, []).append((i, shard, row))

@@ -54,3 +54,19 @@ def test_store_uses_router():
 
     assert key_to_shard("k", "b", 8) == router.shard_of("k", "b", 8)
     assert key_to_shard(13, "b", 8) == 13 % 8
+
+
+def test_locate_many_matches_scalar_routing():
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.store.kv import KVStore, key_to_shard
+
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, ops_per_key=4,
+                         snap_versions=2, keys_per_table=16)
+    store = KVStore(cfg)
+    objs = [(f"key-{i}", "counter_pn", "bk") for i in range(40)]
+    objs += [(i, "counter_pn", "bk") for i in range(10)]  # direct-int path
+    store.locate_many(objs)
+    for key, tname, bucket in objs:
+        ent = store.locate(key, tname, bucket, create=False)
+        assert ent is not None
+        assert ent[1] == key_to_shard(key, bucket, cfg.n_shards)
