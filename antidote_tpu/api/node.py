@@ -41,7 +41,13 @@ class AntidoteNode:
         log_dir: Optional[str] = None,
         recover: bool = False,
         meta=None,
+        store: Optional[KVStore] = None,
     ):
+        """``store`` adopts an existing KVStore (e.g. the output of
+        ``handoff.reshard``) instead of building one; ``log_dir`` must be
+        None then — the adopted store keeps its own log."""
+        if store is not None and cfg is None:
+            cfg = store.cfg
         self.cfg = cfg or AntidoteConfig()
         self.dc_id = dc_id
         # durable, DC-replicated metadata/flag store (stable_meta_data_server)
@@ -51,7 +57,15 @@ class AntidoteNode:
             meta = MetaDataStore()
         self.meta = meta
         log = None
-        if log_dir is not None and self.cfg.enable_logging:
+        if store is not None:
+            assert log_dir is None, "store= and log_dir= are exclusive"
+            if recover:
+                raise RuntimeError(
+                    "store= adopts already-populated tables; recover=True "
+                    "would replay its log on top of them (double-apply)"
+                )
+            log = store.log
+        elif log_dir is not None and self.cfg.enable_logging:
             import glob
             import os
 
@@ -77,7 +91,9 @@ class AntidoteNode:
             raise RuntimeError(
                 "recover=True requires log_dir and cfg.enable_logging"
             )
-        self.store = KVStore(self.cfg, sharding=sharding, log=log)
+        self.store = store if store is not None else KVStore(
+            self.cfg, sharding=sharding, log=log
+        )
         self.txm = TransactionManager(
             self.store, my_dc=dc_id,
             cert=self.meta.get_env("txn_cert", cert),
@@ -93,6 +109,11 @@ class AntidoteNode:
             self.metrics, logging.getLogger("antidote_tpu")
         )
         self._metrics_server = None
+        if store is not None:
+            # adopted (already-populated) store: continue the commit
+            # counter above every applied clock so new commits never mint
+            # duplicate (counter, origin) dots
+            self.txm.commit_counter = int(self.store.dc_max_vc()[dc_id])
         if recover and log is not None:
             # node restart: replay the durable log into the device tables
             # and rebuild the certification table + commit counter
@@ -103,6 +124,33 @@ class AntidoteNode:
         # react to replicated flag flips from ANY node in the DC
         # (registered last: construction-time get_env seeds fire watchers)
         self.meta.watch(self._on_meta_change)
+
+    # --- shard handoff (riak_core handoff receiver) ---------------------
+    def receive_handoff(self, pkg, shard: Optional[int] = None) -> None:
+        """Install an exported shard package (see store/handoff.py) and
+        re-sync the commit counter above every imported clock, so this
+        node's own-lane snapshots cover the moved commits."""
+        from antidote_tpu.store import handoff as _handoff
+
+        _handoff.import_shard(self.store, pkg, shard)
+        self.txm.commit_counter = max(
+            self.txm.commit_counter,
+            int(self.store.dc_max_vc()[self.dc_id]),
+        )
+        # rebuild the certification table for the moved keys: their last
+        # own-lane commit is the head clock's own lane (same role as the
+        # recover path's track_origin scan) — without this, a txn whose
+        # snapshot predates the import could overwrite a moved commit
+        # unchecked (first-committer-wins violation)
+        from antidote_tpu.store.kv import freeze_key
+
+        for key, bucket, tname, row in pkg["directory"]:
+            lane = int(pkg["tables"][tname]["head_vc"][row][self.dc_id])
+            if lane:
+                dk = (freeze_key(key), bucket)
+                self.txm.committed_keys[dk] = max(
+                    self.txm.committed_keys.get(dk, 0), lane
+                )
 
     # --- transactions (antidote.erl:36-54) -----------------------------
     def start_transaction(self, clock=None, props=None) -> Transaction:

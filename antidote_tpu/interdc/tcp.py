@@ -73,8 +73,17 @@ class _Endpoint:
                 except (ConnectionError, OSError):
                     return
                 if kind == K_SUB:
-                    # this connection becomes a push stream; hold it open
+                    # ack + register under the subs lock: pushes also write
+                    # under this lock, so (a) the ack can never interleave
+                    # with a push frame, and (b) once subscribe() returns,
+                    # every later publish sees this socket registered
+                    # (observe_dcs_sync semantics,
+                    # /root/reference/src/inter_dc_manager.erl:209-230)
                     with ep._subs_lock:
+                        try:
+                            _send(self.request, K_REPLY, "subscribed")
+                        except OSError:
+                            return
                         ep._subs.append(self.request)
                     # park until the peer closes (reads detect EOF)
                     try:
@@ -130,15 +139,14 @@ class _Endpoint:
         raise ValueError(f"unknown frame kind {kind}")
 
     def push(self, data: bytes) -> None:
+        # sends happen under the subs lock: stream sockets have exactly one
+        # writer at a time, so frames never interleave mid-write
         with self._subs_lock:
-            conns = list(self._subs)
-        for c in conns:
-            try:
-                _send(c, K_PUSH, data)
-            except OSError:
-                with self._subs_lock:
-                    if c in self._subs:
-                        self._subs.remove(c)
+            for c in list(self._subs):
+                try:
+                    _send(c, K_PUSH, data)
+                except OSError:
+                    self._subs.remove(c)
 
     def close(self) -> None:
         self._server.shutdown()
@@ -197,6 +205,10 @@ class TcpFabric:
         sock = socket.create_connection((host, port))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send(sock, K_SUB, subscriber_dc)
+        # wait for the registration ack before handing the socket to the
+        # reader thread — subscribe() returning means the stream is live
+        kind, _ = _recv(sock)
+        assert kind == K_REPLY, kind
 
         def reader():
             try:

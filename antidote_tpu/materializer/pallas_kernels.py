@@ -133,9 +133,14 @@ def counter_fold(base_cnt, deltas, ops_vc, n_ops, base_vc, read_vc,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    deltas = jnp.asarray(deltas)
-    k = max(int(deltas.shape[-1]), 1)
-    peak = int(np.abs(np.asarray(deltas)).max()) if deltas.size else 0
+    k = max(int(np.shape(deltas)[-1]), 1)
+    if isinstance(deltas, np.ndarray):
+        # host input: the bound check is free (no device sync)
+        peak = int(np.abs(deltas).max()) if deltas.size else 0
+    else:
+        deltas = jnp.asarray(deltas)
+        # device input: one scalar readback, not a full-array copy
+        peak = int(jnp.abs(deltas).max()) if deltas.size else 0
     if peak > _I32_MAX // k:
         raise ValueError(
             f"counter_fold: |delta| up to {peak} could overflow the i32 "

@@ -1,0 +1,282 @@
+"""Shard handoff & resharding — the riak_core handoff analogue.
+
+The reference migrates a partition by folding its vnode state into handoff
+messages: materializer_vnode folds ``ops_cache``
+(/root/reference/src/materializer_vnode.erl:221-246), logging_vnode folds
+every log record (/root/reference/src/logging_vnode.erl:781-812), and
+riak_core replays the fold at the receiver.  Here a shard is a slice of
+the per-type device tables plus its WAL, so handoff is three batched
+moves:
+
+  * ``export_shard``   — gather one shard's rows off-device into a
+    serializable package (tables + directory + clocks + WAL records).
+  * ``import_shard``   — scatter a package into a destination replica
+    (one ``.at[shard, base:base+n].set`` per array), re-chain the WAL.
+  * ``drop_shard``     — zero the source slice after a successful move.
+
+``reshard`` rebuilds a replica onto a different shard count: every key is
+re-routed with one native ``shard_batch`` crossing and every table row
+moves with one gather + one scatter per array — no per-key work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import msgpack
+import numpy as np
+
+from antidote_tpu.store.kv import KVStore, freeze_key
+from antidote_tpu.store.router import shard_batch
+
+
+def _table_slice(t, shard: int, used: int) -> Dict[str, Any]:
+    out = {
+        "snap": {f: np.asarray(x[shard, :used]) for f, x in t.snap.items()},
+        "snap_vc": np.asarray(t.snap_vc[shard, :used]),
+        "snap_seq": np.asarray(t.snap_seq[shard, :used]),
+        "ops_a": np.asarray(t.ops_a[shard, :used]),
+        "ops_b": np.asarray(t.ops_b[shard, :used]),
+        "ops_vc": np.asarray(t.ops_vc[shard, :used]),
+        "ops_origin": np.asarray(t.ops_origin[shard, :used]),
+        "n_ops": t.n_ops[shard, :used].copy(),
+        "head": {f: np.asarray(x[shard, :used]) for f, x in t.head.items()},
+        "head_vc": np.asarray(t.head_vc[shard, :used]),
+    }
+    return out
+
+
+def export_shard(store: KVStore, shard: int,
+                 include_log: bool = True) -> Dict[str, Any]:
+    """Package one shard of a replica for transfer.
+
+    Returns a dict of host arrays + metadata; ``pack``/``unpack`` turn it
+    into wire bytes for a cross-node move.
+    """
+    pkg: Dict[str, Any] = {
+        "shard": int(shard),
+        "applied_vc": store.applied_vc[shard].copy(),
+        "tables": {},
+        "directory": [],
+        "log": [],
+        "op_ids": None,
+        # content-addressed payload bytes: handles are stable hashes, so
+        # shipping the whole dict is safe (receiver setdefaults); shipping
+        # only the shard's reachable handles is a size optimization the
+        # reference doesn't need because it sends full terms inline
+        "blobs": [(int(h), bytes(d)) for h, d in store.blobs._by_handle.items()],
+    }
+    for tname, t in store.tables.items():
+        used = int(t.used_rows[shard])
+        if used == 0:
+            continue
+        sl = _table_slice(t, shard, used)
+        sl["used"] = used
+        sl["next_seq"] = int(t.next_seq)
+        pkg["tables"][tname] = sl
+    for (key, bucket), (tname, s, row) in store.directory.items():
+        if s == shard:
+            pkg["directory"].append((key, bucket, tname, int(row)))
+    if include_log and store.log is not None:
+        pkg["log"] = list(store.log.replay_shard(shard))
+        pkg["op_ids"] = store.log.op_ids[shard].copy()
+    return pkg
+
+
+def import_shard(store: KVStore, pkg: Dict[str, Any],
+                 shard: Optional[int] = None) -> None:
+    """Merge an exported shard into ``store`` at ``shard`` (defaults to the
+    package's original shard index).  Imported rows are appended after the
+    destination's existing rows; the directory re-binds keys to their new
+    (shard, row) homes.  Key collisions (same (key, bucket) already bound
+    here) are rejected — a shard has exactly one home per ring epoch.
+    """
+    dst = int(pkg["shard"] if shard is None else shard)
+    bases: Dict[str, int] = {}
+    for tname, sl in pkg["tables"].items():
+        t = store.table(tname)
+        used = int(sl["used"])
+        base = int(t.used_rows[dst])
+        while base + used > t.n_rows:
+            t._grow()
+        bases[tname] = base
+        end = base + used
+        for f in t.snap:
+            t.snap[f] = t.snap[f].at[dst, base:end].set(sl["snap"][f])
+            t.head[f] = t.head[f].at[dst, base:end].set(sl["head"][f])
+        # renumber snapshot sequence ids above everything local so the
+        # per-key newest-version order is preserved
+        seq = np.asarray(sl["snap_seq"], np.int64)
+        seq = np.where(seq > 0, seq + t.next_seq, 0)
+        t.next_seq += int(sl["next_seq"])
+        t.snap_vc = t.snap_vc.at[dst, base:end].set(sl["snap_vc"])
+        t.snap_seq = t.snap_seq.at[dst, base:end].set(seq)
+        t.ops_a = t.ops_a.at[dst, base:end].set(sl["ops_a"])
+        t.ops_b = t.ops_b.at[dst, base:end].set(sl["ops_b"])
+        t.ops_vc = t.ops_vc.at[dst, base:end].set(sl["ops_vc"])
+        t.ops_origin = t.ops_origin.at[dst, base:end].set(sl["ops_origin"])
+        t.head_vc = t.head_vc.at[dst, base:end].set(sl["head_vc"])
+        t.n_ops[dst, base:end] = sl["n_ops"]
+        t.used_rows[dst] = end
+    for key, bucket, tname, row in pkg["directory"]:
+        key = freeze_key(key)
+        dk = (key, bucket)
+        if dk in store.directory:
+            raise ValueError(
+                f"import_shard: {dk!r} already bound on this replica"
+            )
+        store.directory[dk] = (tname, dst, bases[tname] + int(row))
+    for h, data in pkg.get("blobs", []):
+        store.blobs.intern_bytes(int(h), bytes(data))
+    np.maximum(store.applied_vc[dst], pkg["applied_vc"],
+               out=store.applied_vc[dst])
+    if pkg["log"] and store.log is not None:
+        for rec in pkg["log"]:
+            store.log.log_effect(
+                dst, freeze_key(rec["k"]), rec["t"], rec["b"],
+                np.frombuffer(rec["a"], np.int64),
+                np.frombuffer(rec["eb"], np.int32),
+                np.asarray(rec["vc"], np.int32), int(rec["o"]),
+                blob_refs=[(h, d) for h, d in rec.get("bl", [])],
+            )
+        store.log.commit_barrier([dst])
+
+
+def drop_shard(store: KVStore, shard: int) -> None:
+    """Clear a shard after a successful handoff (source side)."""
+    for t in store.tables.values():
+        used = int(t.used_rows[shard])
+        if used:
+            for f in t.snap:
+                t.snap[f] = t.snap[f].at[shard].set(0)
+                t.head[f] = t.head[f].at[shard].set(0)
+            t.snap_vc = t.snap_vc.at[shard].set(0)
+            t.snap_seq = t.snap_seq.at[shard].set(0)
+            t.ops_a = t.ops_a.at[shard].set(0)
+            t.ops_b = t.ops_b.at[shard].set(0)
+            t.ops_vc = t.ops_vc.at[shard].set(0)
+            t.ops_origin = t.ops_origin.at[shard].set(0)
+            t.head_vc = t.head_vc.at[shard].set(0)
+            t.n_ops[shard] = 0
+        t.used_rows[shard] = 0
+    store.directory = {
+        dk: ent for dk, ent in store.directory.items() if ent[1] != shard
+    }
+    store.applied_vc[shard] = 0
+
+
+def pack(pkg: Dict[str, Any]) -> bytes:
+    """Wire form of an exported shard (msgpack; arrays as raw bytes)."""
+
+    def enc(x):
+        if isinstance(x, np.ndarray):
+            return {"__nd": True, "d": str(x.dtype), "s": list(x.shape),
+                    "b": x.tobytes()}
+        if isinstance(x, dict):
+            return {k: enc(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [enc(v) for v in x]
+        return x
+
+    return msgpack.packb(enc(pkg), use_bin_type=True)
+
+
+def unpack(data: bytes) -> Dict[str, Any]:
+    def dec(x):
+        if isinstance(x, dict):
+            if x.get("__nd"):
+                return np.frombuffer(x["b"], x["d"]).reshape(x["s"]).copy()
+            return {k: dec(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [dec(v) for v in x]
+        return x
+
+    return dec(msgpack.unpackb(data, raw=False, strict_map_key=False))
+
+
+def reshard(store: KVStore, new_cfg, log=None) -> KVStore:
+    """Rebuild a replica onto a different shard count (ring resize).
+
+    ``new_cfg`` must differ from ``store.cfg`` only in ``n_shards``.  Every
+    key re-routes via one ``shard_batch`` crossing; each table moves with
+    one host gather + one device scatter per array.  Returns the new store
+    (the old one is left untouched).
+    """
+    old_cfg = store.cfg
+    assert new_cfg.max_dcs == old_cfg.max_dcs
+    assert new_cfg.ops_per_key == old_cfg.ops_per_key
+    assert new_cfg.snap_versions == old_cfg.snap_versions
+    new = KVStore(new_cfg, log=log)
+
+    items = list(store.directory.items())
+    keys = [dk[0] for dk, _ in items]
+    buckets = [dk[1] for dk, _ in items]
+    new_shards = shard_batch(keys, buckets, new_cfg.n_shards)
+
+    by_type: Dict[str, List] = {}
+    for i, (dk, (tname, s, row)) in enumerate(items):
+        by_type.setdefault(tname, []).append((dk, s, row, int(new_shards[i])))
+
+    for tname, ents in by_type.items():
+        src = store.tables[tname]
+        dst = new.table(tname)
+        old_s = np.asarray([e[1] for e in ents], np.int64)
+        old_r = np.asarray([e[2] for e in ents], np.int64)
+        ns = np.asarray([e[3] for e in ents], np.int64)
+        # allocate contiguous rows per new shard
+        nr = np.empty(len(ents), np.int64)
+        for p in range(new_cfg.n_shards):
+            m = ns == p
+            cnt = int(m.sum())
+            if cnt == 0:
+                continue
+            base = int(dst.used_rows[p])
+            while base + cnt > dst.n_rows:
+                dst._grow()
+            nr[m] = base + np.arange(cnt)
+            dst.used_rows[p] = base + cnt
+        for f in dst.snap:
+            dst.snap[f] = dst.snap[f].at[ns, nr].set(
+                np.asarray(src.snap[f])[old_s, old_r])
+            dst.head[f] = dst.head[f].at[ns, nr].set(
+                np.asarray(src.head[f])[old_s, old_r])
+        dst.snap_vc = dst.snap_vc.at[ns, nr].set(
+            np.asarray(src.snap_vc)[old_s, old_r])
+        dst.snap_seq = dst.snap_seq.at[ns, nr].set(
+            np.asarray(src.snap_seq)[old_s, old_r])
+        dst.ops_a = dst.ops_a.at[ns, nr].set(np.asarray(src.ops_a)[old_s, old_r])
+        dst.ops_b = dst.ops_b.at[ns, nr].set(np.asarray(src.ops_b)[old_s, old_r])
+        dst.ops_vc = dst.ops_vc.at[ns, nr].set(
+            np.asarray(src.ops_vc)[old_s, old_r])
+        dst.ops_origin = dst.ops_origin.at[ns, nr].set(
+            np.asarray(src.ops_origin)[old_s, old_r])
+        dst.head_vc = dst.head_vc.at[ns, nr].set(
+            np.asarray(src.head_vc)[old_s, old_r])
+        dst.n_ops[ns, nr] = src.n_ops[old_s, old_r]
+        dst.next_seq = max(dst.next_seq, src.next_seq)
+        for i, (dk, _, _, _) in enumerate(ents):
+            new.directory[dk] = (tname, int(ns[i]), int(nr[i]))
+
+    # every commit applied on the old ring is applied on the new one: seed
+    # all new shards with the DC-wide applied merge so the stable snapshot
+    # (min over shards) never regresses
+    merged = store.applied_vc.max(axis=0)
+    new.applied_vc[:] = merged
+    new.blobs = store.blobs
+    # re-chain the durable log onto the new ring
+    if log is not None and store.log is not None:
+        for s in range(old_cfg.n_shards):
+            for rec in store.log.replay_shard(s):
+                key = freeze_key(rec["k"])
+                ent = new.directory.get((key, rec["b"]))
+                if ent is None:
+                    continue
+                log.log_effect(
+                    ent[1], key, rec["t"], rec["b"],
+                    np.frombuffer(rec["a"], np.int64),
+                    np.frombuffer(rec["eb"], np.int32),
+                    np.asarray(rec["vc"], np.int32), int(rec["o"]),
+                    blob_refs=[(h, d) for h, d in rec.get("bl", [])],
+                )
+        log.commit_barrier(range(new_cfg.n_shards))
+    return new
