@@ -27,6 +27,16 @@ import numpy as np
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.interdc.messages import Descriptor, TxnMessage
 from antidote_tpu.interdc.transport import LoopbackHub
+from antidote_tpu.store.kv import Effect, freeze_key
+
+
+def _effect_from_rec(rec) -> Effect:
+    return Effect(
+        freeze_key(rec["k"]), rec["t"], rec["b"],
+        np.frombuffer(rec["a"], np.int64),
+        np.frombuffer(rec["eb"], np.int32),
+        [(h, d) for h, d in rec.get("bl", [])],
+    )
 
 
 class DCReplica:
@@ -62,6 +72,52 @@ class DCReplica:
                                  "to_dc": self.dc_id},
             )
         )
+
+    # ------------------------------------------------------------------
+    # restart (check_node_restart, /root/reference/src/inter_dc_manager.erl:156-206)
+    # ------------------------------------------------------------------
+    def restore_from_log(self) -> None:
+        """Rebuild replication chains after a node restart from its WAL.
+
+        Egress: my own-origin records regroup into per-shard TxnMessages
+        with fresh sequential opids, so peers' catch-up queries keep
+        working (the reference re-reads its disk log for this,
+        /root/reference/src/inter_dc_query_response.erl:97-126).
+        Ingress: each remote (origin, shard) chain's delivered-txn count
+        IS the publisher's opid (one opid per txn per shard, delivered
+        exactly once in order), so ``last_seen`` reseeds from the log
+        (inter_dc_sub_buf restart seeding,
+        /root/reference/src/inter_dc_sub_buf.erl:58-76).
+        """
+        store = self.node.store
+        assert store.log is not None, "restore_from_log needs a WAL"
+        for shard in range(self.node.cfg.n_shards):
+            groups: List[Tuple[int, tuple, list]] = []  # (origin, vc, effs)
+            for rec in store.log.replay_shard(shard):
+                vc = tuple(int(x) for x in rec["vc"])
+                eff = _effect_from_rec(rec)
+                if groups and groups[-1][0] == rec["o"] and groups[-1][1] == vc:
+                    groups[-1][2].append(eff)
+                else:
+                    groups.append((int(rec["o"]), vc, [eff]))
+            counts: Dict[int, int] = collections.defaultdict(int)
+            for origin, vc, effs in groups:
+                counts[origin] += 1
+                if origin != self.dc_id:
+                    continue
+                prev = int(self.pub_opid[shard])
+                self.pub_opid[shard] += 1
+                cvc = np.asarray(vc, np.int32)
+                svc = cvc.copy()
+                svc[origin] = 0
+                self.sent[shard].append(TxnMessage(
+                    origin=origin, shard=shard, prev_opid=prev,
+                    last_opid=prev + 1, commit_vc=cvc, snapshot_vc=svc,
+                    effects=effs, timestamp=int(cvc[origin]),
+                ))
+            for origin, n in counts.items():
+                if origin != self.dc_id:
+                    self.last_seen[(origin, shard)] = n
 
     # ------------------------------------------------------------------
     def descriptor(self) -> Descriptor:
@@ -232,6 +288,17 @@ class DCReplica:
                     msg = q[0]
                     if msg.is_ping:
                         self._advance_clock(shard, origin, msg.timestamp)
+                        q.popleft()
+                        progressed = True
+                        continue
+                    # duplicate suppression: per-chain origin timestamps are
+                    # strictly monotone, and the chain clock only advances
+                    # past ts once the txn carrying ts was applied (or a
+                    # catch-up replayed it) — so ts ≤ clock ⟺ already
+                    # applied.  Makes re-delivery (restart catch-up from a
+                    # conservative opid) idempotent.
+                    if (int(msg.commit_vc[origin])
+                            <= int(self.node.store.applied_vc[shard, origin])):
                         q.popleft()
                         progressed = True
                         continue

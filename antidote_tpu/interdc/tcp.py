@@ -62,7 +62,9 @@ class _Endpoint:
         self.lock = threading.RLock()          # guards handler invocations
         self.query_handler: Optional[Callable] = None
         self.request_handler: Optional[Callable] = None
-        self._subs: List[socket.socket] = []
+        #: (socket, per-connection write lock) — the write lock serializes
+        #: frames on one stream; _subs_lock guards only list membership
+        self._subs: List[Tuple[socket.socket, threading.Lock]] = []
         self._subs_lock = threading.Lock()
         ep = self
 
@@ -73,18 +75,32 @@ class _Endpoint:
                 except (ConnectionError, OSError):
                     return
                 if kind == K_SUB:
-                    # ack + register under the subs lock: pushes also write
-                    # under this lock, so (a) the ack can never interleave
-                    # with a push frame, and (b) once subscribe() returns,
-                    # every later publish sees this socket registered
-                    # (observe_dcs_sync semantics,
+                    # a send-only timeout (SO_SNDTIMEO) bounds how long one
+                    # stalled subscriber can hold its write lock; reads
+                    # (the park loop below) are unaffected
+                    self.request.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", 10, 0),
+                    )
+                    # register + ack while holding this connection's write
+                    # lock: a concurrent push that snapshots the list right
+                    # after registration blocks on the lock until the ack
+                    # frame is fully out — so the ack is always the stream's
+                    # first frame, and once subscribe() returns every later
+                    # publish sees the socket (observe_dcs_sync semantics,
                     # /root/reference/src/inter_dc_manager.erl:209-230)
-                    with ep._subs_lock:
+                    wlock = threading.Lock()
+                    entry = (self.request, wlock)
+                    with wlock:
+                        with ep._subs_lock:
+                            ep._subs.append(entry)
                         try:
                             _send(self.request, K_REPLY, "subscribed")
                         except OSError:
+                            with ep._subs_lock:
+                                if entry in ep._subs:
+                                    ep._subs.remove(entry)
                             return
-                        ep._subs.append(self.request)
                     # park until the peer closes (reads detect EOF)
                     try:
                         while self.request.recv(1):
@@ -92,8 +108,8 @@ class _Endpoint:
                     except OSError:
                         pass
                     with ep._subs_lock:
-                        if self.request in ep._subs:
-                            ep._subs.remove(self.request)
+                        if entry in ep._subs:
+                            ep._subs.remove(entry)
                     return
                 # query connection: serve request/reply until EOF
                 while True:
@@ -139,20 +155,27 @@ class _Endpoint:
         raise ValueError(f"unknown frame kind {kind}")
 
     def push(self, data: bytes) -> None:
-        # sends happen under the subs lock: stream sockets have exactly one
-        # writer at a time, so frames never interleave mid-write
         with self._subs_lock:
-            for c in list(self._subs):
-                try:
+            conns = list(self._subs)
+        for entry in conns:
+            c, wlock = entry
+            try:
+                with wlock:  # one writer per stream; frames never interleave
                     _send(c, K_PUSH, data)
+            except OSError:  # dead or stalled past SO_SNDTIMEO: drop it
+                with self._subs_lock:
+                    if entry in self._subs:
+                        self._subs.remove(entry)
+                try:
+                    c.close()
                 except OSError:
-                    self._subs.remove(c)
+                    pass
 
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
         with self._subs_lock:
-            for c in self._subs:
+            for c, _ in self._subs:
                 try:
                     c.close()
                 except OSError:

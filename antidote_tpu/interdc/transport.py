@@ -41,6 +41,23 @@ class LoopbackHub:
         self.subscribers.setdefault(dc_id, [])
         self.query_handlers[dc_id] = query_handler
 
+    def unregister(self, dc_id: int) -> None:
+        """Forget a DC's handlers, subscriptions AND queued deliveries
+        (node crash/restart: nothing may reach the ghost replica's
+        callbacks — its dead node still holds the WAL files the reborn
+        one appends to)."""
+        self.query_handlers.pop(dc_id, None)
+        self.request_handlers.pop(dc_id, None)
+        self.subscribers.pop(dc_id, None)
+        for pub, subs in self.subscribers.items():
+            self.subscribers[pub] = [
+                (to_dc, cb) for to_dc, cb in subs if to_dc != dc_id
+            ]
+        self.queues = collections.deque(
+            (to_dc, cb, data) for to_dc, cb, data in self.queues
+            if to_dc != dc_id
+        )
+
     def register_request(self, dc_id: int, handler: Callable) -> None:
         """Attach a generic request handler ((kind, payload) -> reply) —
         the other message types of the REQ/XREP channel
@@ -66,7 +83,7 @@ class LoopbackHub:
                 self.drop[key] -= 1
                 self.dropped += 1
                 continue
-            self.queues.append((cb, data))
+            self.queues.append((to_dc, cb, data))
 
     def query_log(self, target_dc: int, shard: int, origin: int,
                   from_opid: int) -> List[bytes]:
@@ -82,7 +99,7 @@ class LoopbackHub:
         """Deliver queued messages until quiescent; returns count."""
         n = 0
         while self.queues and n < max_rounds:
-            cb, data = self.queues.popleft()
+            _, cb, data = self.queues.popleft()
             cb(data)
             self.delivered += 1
             n += 1

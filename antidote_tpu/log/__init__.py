@@ -77,6 +77,20 @@ class LogManager:
         for p in set(int(s) for s in shards):
             self.wals[p].commit()
 
+    def truncate_shard(self, shard: int) -> None:
+        """Discard one shard's log (post-handoff cleanup: the records now
+        live in the receiver's chain).  Resets the shard's op-id chains and
+        blob-dedup memory along with the file."""
+        path = os.path.join(self.dir, f"shard_{shard}.wal")
+        self.wals[shard].close()
+        if os.path.exists(path):
+            os.remove(path)
+        self.wals[shard] = ShardWAL(
+            path, sync_on_commit=self.wals[shard].sync_on_commit
+        )
+        self.op_ids[shard] = 0
+        self._blob_seen[shard].clear()
+
     def replay_shard(self, shard: int) -> Iterator[dict]:
         return replay(os.path.join(self.dir, f"shard_{shard}.wal"))
 

@@ -53,18 +53,23 @@ def export_shard(store: KVStore, shard: int,
     Returns a dict of host arrays + metadata; ``pack``/``unpack`` turn it
     into wire bytes for a cross-node move.
     """
+    with_log = include_log and store.log is not None
     pkg: Dict[str, Any] = {
         "shard": int(shard),
         "applied_vc": store.applied_vc[shard].copy(),
         "tables": {},
         "directory": [],
         "log": [],
-        "op_ids": None,
-        # content-addressed payload bytes: handles are stable hashes, so
-        # shipping the whole dict is safe (receiver setdefaults); shipping
-        # only the shard's reachable handles is a size optimization the
-        # reference doesn't need because it sends full terms inline
-        "blobs": [(int(h), bytes(d)) for h, d in store.blobs._by_handle.items()],
+        # payload bytes for value handles: when the WAL rides along, its
+        # records carry every handle this shard references (first use per
+        # shard logs the bytes — log/__init__.py _blob_seen), so shipping
+        # the blob dict again would be pure duplication.  Without a log we
+        # cannot tell which handles the shard's state references (handle
+        # lanes are type-specific), so ship the whole content-addressed
+        # dict — receivers setdefault, duplicates are free.
+        "blobs": [] if with_log else [
+            (int(h), bytes(d)) for h, d in store.blobs._by_handle.items()
+        ],
     }
     for tname, t in store.tables.items():
         used = int(t.used_rows[shard])
@@ -77,9 +82,8 @@ def export_shard(store: KVStore, shard: int,
     for (key, bucket), (tname, s, row) in store.directory.items():
         if s == shard:
             pkg["directory"].append((key, bucket, tname, int(row)))
-    if include_log and store.log is not None:
+    if with_log:
         pkg["log"] = list(store.log.replay_shard(shard))
-        pkg["op_ids"] = store.log.op_ids[shard].copy()
     return pkg
 
 
@@ -92,6 +96,14 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
     here) are rejected — a shard has exactly one home per ring epoch.
     """
     dst = int(pkg["shard"] if shard is None else shard)
+    # validate BEFORE any mutation: a rejected import must leave the
+    # destination untouched (no orphan rows / partial directory)
+    for key, bucket, _, _ in pkg["directory"]:
+        dk = (freeze_key(key), bucket)
+        if dk in store.directory:
+            raise ValueError(
+                f"import_shard: {dk!r} already bound on this replica"
+            )
     bases: Dict[str, int] = {}
     for tname, sl in pkg["tables"].items():
         t = store.table(tname)
@@ -119,19 +131,18 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
         t.n_ops[dst, base:end] = sl["n_ops"]
         t.used_rows[dst] = end
     for key, bucket, tname, row in pkg["directory"]:
-        key = freeze_key(key)
-        dk = (key, bucket)
-        if dk in store.directory:
-            raise ValueError(
-                f"import_shard: {dk!r} already bound on this replica"
-            )
-        store.directory[dk] = (tname, dst, bases[tname] + int(row))
+        store.directory[(freeze_key(key), bucket)] = (
+            tname, dst, bases[tname] + int(row)
+        )
     for h, data in pkg.get("blobs", []):
         store.blobs.intern_bytes(int(h), bytes(data))
     np.maximum(store.applied_vc[dst], pkg["applied_vc"],
                out=store.applied_vc[dst])
-    if pkg["log"] and store.log is not None:
-        for rec in pkg["log"]:
+    for rec in pkg["log"]:
+        # the ride-along WAL records carry this shard's blob bytes
+        for h, data in rec.get("bl", []):
+            store.blobs.intern_bytes(int(h), bytes(data))
+        if store.log is not None:
             store.log.log_effect(
                 dst, freeze_key(rec["k"]), rec["t"], rec["b"],
                 np.frombuffer(rec["a"], np.int64),
@@ -139,6 +150,7 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
                 np.asarray(rec["vc"], np.int32), int(rec["o"]),
                 blob_refs=[(h, d) for h, d in rec.get("bl", [])],
             )
+    if pkg["log"] and store.log is not None:
         store.log.commit_barrier([dst])
 
 
@@ -163,6 +175,9 @@ def drop_shard(store: KVStore, shard: int) -> None:
         dk: ent for dk, ent in store.directory.items() if ent[1] != shard
     }
     store.applied_vc[shard] = 0
+    if store.log is not None:
+        # the moved records must not resurrect here on the next recover
+        store.log.truncate_shard(shard)
 
 
 def pack(pkg: Dict[str, Any]) -> bytes:

@@ -99,6 +99,40 @@ def test_drop_shard_clears_source():
         assert (t.n_ops[victim] == 0).all()
 
 
+def test_drop_shard_truncates_wal_no_resurrection(tmp_path):
+    """After handoff + drop, a recover on the SOURCE must not resurrect the
+    moved keys (their WAL records moved with them)."""
+    cfg = mk_cfg()
+    a = AntidoteNode(cfg, log_dir=str(tmp_path / "a"))
+    a.update_objects([("k", "counter_pn", "bk", ("increment", 9))])
+    victim = a.store.locate("k", "counter_pn", "bk")[1]
+    b = AntidoteNode(cfg, log_dir=str(tmp_path / "b"))
+    b.receive_handoff(handoff.export_shard(a.store, victim))
+    handoff.drop_shard(a.store, victim)
+    a2 = AntidoteNode(cfg, log_dir=str(tmp_path / "a"), recover=True)
+    assert a2.store.locate("k", "counter_pn", "bk", create=False) is None
+    vals, _ = b.read_objects([("k", "counter_pn", "bk")])
+    assert vals == [9]
+
+
+def test_import_failure_leaves_destination_untouched():
+    """A colliding import must reject BEFORE mutating anything."""
+    cfg = mk_cfg()
+    a = AntidoteNode(cfg)
+    a.update_objects([("k", "counter_pn", "bk", ("increment", 1)),
+                      ("other", "counter_pn", "bk", ("increment", 2))])
+    shard = a.store.locate("k", "counter_pn", "bk")[1]
+    pkg = handoff.export_shard(a.store, shard)
+    used_before = {t: a.store.tables[t].used_rows.copy()
+                   for t in a.store.tables}
+    dir_before = dict(a.store.directory)
+    with pytest.raises(ValueError, match="already bound"):
+        handoff.import_shard(a.store, pkg)
+    assert dict(a.store.directory) == dir_before
+    for t, used in used_before.items():
+        np.testing.assert_array_equal(a.store.tables[t].used_rows, used)
+
+
 def test_handoff_with_log_recovers(tmp_path):
     cfg = mk_cfg()
     a = AntidoteNode(cfg, log_dir=str(tmp_path / "a"))
