@@ -27,16 +27,7 @@ import numpy as np
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.interdc.messages import Descriptor, TxnMessage
 from antidote_tpu.interdc.transport import LoopbackHub
-from antidote_tpu.store.kv import Effect, freeze_key
-
-
-def _effect_from_rec(rec) -> Effect:
-    return Effect(
-        freeze_key(rec["k"]), rec["t"], rec["b"],
-        np.frombuffer(rec["a"], np.int64),
-        np.frombuffer(rec["eb"], np.int32),
-        [(h, d) for h, d in rec.get("bl", [])],
-    )
+from antidote_tpu.store.kv import effect_from_rec
 
 
 class DCReplica:
@@ -95,11 +86,17 @@ class DCReplica:
             groups: List[Tuple[int, tuple, list]] = []  # (origin, vc, effs)
             for rec in store.log.replay_shard(shard):
                 vc = tuple(int(x) for x in rec["vc"])
-                eff = _effect_from_rec(rec)
+                mine = int(rec["o"]) == self.dc_id
+                # effects are only materialized for my own chain (egress
+                # rebuild); remote groups just count toward last_seen
                 if groups and groups[-1][0] == rec["o"] and groups[-1][1] == vc:
-                    groups[-1][2].append(eff)
+                    if mine:
+                        groups[-1][2].append(effect_from_rec(rec))
                 else:
-                    groups.append((int(rec["o"]), vc, [eff]))
+                    groups.append((
+                        int(rec["o"]), vc,
+                        [effect_from_rec(rec)] if mine else [],
+                    ))
             counts: Dict[int, int] = collections.defaultdict(int)
             for origin, vc, effs in groups:
                 counts[origin] += 1
@@ -168,6 +165,14 @@ class DCReplica:
         commit will carry a smaller origin timestamp (commits are minted
         from a monotone counter)."""
         safe = self.node.txm.commit_counter
+        # advance MY lane on idle local shards too: local commits apply
+        # synchronously, so every own-lane op ≤ safe is already applied on
+        # every shard — without this, a remote txn whose snapshot depends
+        # on my lane would gate forever on shards I never wrote to (the
+        # reference's per-partition safe time does the same job,
+        # /root/reference/src/inter_dc_log_sender_vnode.erl:133-143)
+        vc = self.node.store.applied_vc
+        np.maximum(vc[:, self.dc_id], safe, out=vc[:, self.dc_id])
         for shard in range(self.node.cfg.n_shards):
             if shard in exclude:
                 continue

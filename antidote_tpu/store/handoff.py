@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 import msgpack
 import numpy as np
 
-from antidote_tpu.store.kv import KVStore, freeze_key
+from antidote_tpu.store.kv import KVStore, effect_from_rec, freeze_key
 from antidote_tpu.store.router import shard_batch
 
 
@@ -104,6 +104,25 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
             raise ValueError(
                 f"import_shard: {dk!r} already bound on this replica"
             )
+    # exclusive ownership: a shard has one home per ring epoch.  Importing
+    # into a shard that already holds rows would merge two partial copies
+    # of the same (origin, shard) replication chains — the duplicate
+    # suppression in the dependency gate is only sound when the shard's
+    # applied clocks describe THIS replica's chain progress.
+    for tname, t in store.tables.items():
+        if t.used_rows[dst] > 0:
+            raise ValueError(
+                f"import_shard: destination shard {dst} already holds "
+                f"{int(t.used_rows[dst])} {tname!r} rows; hand off into an "
+                "empty shard (exclusive ownership per ring epoch)"
+            )
+    if store.log is not None and pkg["tables"] and not pkg["log"]:
+        raise ValueError(
+            "import_shard: this replica is durable (WAL attached) but the "
+            "package carries no log records — the imported rows could "
+            "never recover and their blob payloads would be lost on "
+            "re-export; export with include_log=True from a logged source"
+        )
     bases: Dict[str, int] = {}
     for tname, sl in pkg["tables"].items():
         t = store.table(tname)
@@ -140,15 +159,14 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
                out=store.applied_vc[dst])
     for rec in pkg["log"]:
         # the ride-along WAL records carry this shard's blob bytes
-        for h, data in rec.get("bl", []):
+        eff = effect_from_rec(rec)
+        for h, data in eff.blob_refs:
             store.blobs.intern_bytes(int(h), bytes(data))
         if store.log is not None:
             store.log.log_effect(
-                dst, freeze_key(rec["k"]), rec["t"], rec["b"],
-                np.frombuffer(rec["a"], np.int64),
-                np.frombuffer(rec["eb"], np.int32),
-                np.asarray(rec["vc"], np.int32), int(rec["o"]),
-                blob_refs=[(h, d) for h, d in rec.get("bl", [])],
+                dst, eff.key, eff.type_name, eff.bucket, eff.eff_a,
+                eff.eff_b, np.asarray(rec["vc"], np.int32), int(rec["o"]),
+                blob_refs=eff.blob_refs,
             )
     if pkg["log"] and store.log is not None:
         store.log.commit_barrier([dst])
@@ -282,16 +300,14 @@ def reshard(store: KVStore, new_cfg, log=None) -> KVStore:
     if log is not None and store.log is not None:
         for s in range(old_cfg.n_shards):
             for rec in store.log.replay_shard(s):
-                key = freeze_key(rec["k"])
-                ent = new.directory.get((key, rec["b"]))
+                eff = effect_from_rec(rec)
+                ent = new.directory.get((eff.key, eff.bucket))
                 if ent is None:
                     continue
                 log.log_effect(
-                    ent[1], key, rec["t"], rec["b"],
-                    np.frombuffer(rec["a"], np.int64),
-                    np.frombuffer(rec["eb"], np.int32),
-                    np.asarray(rec["vc"], np.int32), int(rec["o"]),
-                    blob_refs=[(h, d) for h, d in rec.get("bl", [])],
+                    ent[1], eff.key, eff.type_name, eff.bucket, eff.eff_a,
+                    eff.eff_b, np.asarray(rec["vc"], np.int32),
+                    int(rec["o"]), blob_refs=eff.blob_refs,
                 )
         log.commit_barrier(range(new_cfg.n_shards))
     return new

@@ -40,6 +40,17 @@ def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
     return shard_of(key, bucket, n_shards)
 
 
+def effect_from_rec(rec: dict) -> "Effect":
+    """Decode one WAL record (LogManager.log_effect's wire dict) back into
+    an Effect — the single place that knows the record's lane encoding."""
+    return Effect(
+        freeze_key(rec["k"]), rec["t"], rec["b"],
+        np.frombuffer(rec["a"], np.int64),
+        np.frombuffer(rec["eb"], np.int32),
+        [(h, d) for h, d in rec.get("bl", [])],
+    )
+
+
 class Effect:
     """One downstream effect bound to a key — the unit the log stores and
     replication ships (analogue of #clocksi_payload{},
@@ -288,16 +299,13 @@ class KVStore:
             vcs: List[np.ndarray] = []
             orgs: List[int] = []
             for rec in self.log.replay_shard(shard):
-                for h, data in rec.get("bl", ()):
+                eff = effect_from_rec(rec)
+                for h, data in eff.blob_refs:
                     self.blobs.intern_bytes(h, data)
                     # already durable: don't re-log these payloads later
                     self.log._blob_seen[shard].add(h)
-                ty = get_type(rec["t"])
-                batch.append(Effect(
-                    freeze_key(rec["k"]), rec["t"], rec["b"],
-                    np.frombuffer(rec["a"], np.int64),
-                    np.frombuffer(rec["eb"], np.int32),
-                ))
+                eff.blob_refs = []  # re-logging during replay is disabled
+                batch.append(eff)
                 vcs.append(np.asarray(rec["vc"], np.int32))
                 orgs.append(int(rec["o"]))
                 self.log.op_ids[shard, rec["o"]] = max(

@@ -145,10 +145,10 @@ class TransactionManager:
         if self.metrics is not None and not _internal:
             self.metrics.operations.inc(len(objects), type="read")
         out: List[Any] = [None] * len(objects)
-        plain = []
+        plain, comp = [], []
         for i, (key, t, bucket) in enumerate(objects):
             if is_type(t) and getattr(get_type(t), "composite", False):
-                out[i] = self._read_map(key, t, bucket, txn)
+                comp.append(i)
             else:
                 plain.append(i)
         if plain:
@@ -157,27 +157,40 @@ class TransactionManager:
             for j, i in enumerate(plain):
                 _, t, _ = objects[i]
                 out[i] = get_type(t).value(states[j], self.store.blobs, self.cfg)
+        if comp:
+            vals = self._read_maps([objects[i] for i in comp], txn)
+            for j, i in enumerate(comp):
+                out[i] = vals[j]
         return out
 
-    def _read_map(self, key, map_type: str, bucket: str, txn: Transaction):
-        """Assemble a composite map value: membership + nested reads
-        (recursion handles nested maps)."""
+    def _read_maps(self, objects, txn: Transaction) -> List[dict]:
+        """Assemble composite map values, batched per nesting level: ONE
+        membership read for every map in the batch, then ONE field read
+        across all maps (nested maps recurse — device launches scale with
+        nesting depth, not map count)."""
         from antidote_tpu.crdt import maps as maps_mod
 
-        memb = self.read_objects(
-            [(maps_mod.member_key(key), maps_mod.MAP_MEMBERSHIP[map_type],
-              bucket)], txn, _internal=True,
-        )[0]
-        fields = [tuple(x) for x in memb]
-        if not fields:
-            return {}
-        nested = self.read_objects(
-            [(maps_mod.field_key(key, f, ft), ft, bucket) for f, ft in fields],
+        membs = self.read_objects(
+            [(maps_mod.member_key(key), maps_mod.MAP_MEMBERSHIP[t], bucket)
+             for key, t, bucket in objects],
             txn, _internal=True,
         )
-        return {
-            (f, ft): v for (f, ft), v in zip(fields, nested)
-        }
+        field_objs, spans = [], []
+        for (key, t, bucket), memb in zip(objects, membs):
+            fields = [tuple(x) for x in memb]
+            spans.append((len(field_objs), fields))
+            field_objs.extend(
+                (maps_mod.field_key(key, f, ft), ft, bucket)
+                for f, ft in fields
+            )
+        nested = (
+            self.read_objects(field_objs, txn, _internal=True)
+            if field_objs else []
+        )
+        return [
+            {(f, ft): nested[base + j] for j, (f, ft) in enumerate(fields)}
+            for base, fields in spans
+        ]
 
     def update_objects(self, updates: Sequence[Update], txn: Transaction) -> None:
         assert txn.active
