@@ -125,6 +125,83 @@ class AntidoteNode:
         # (registered last: construction-time get_env seeds fire watchers)
         self.meta.watch(self._on_meta_change)
 
+    # --- readiness (wait_init, /root/reference/src/wait_init.erl:50-88) --
+    def check_ready(self) -> dict:
+        """Probe every subsystem; returns {probe: bool}.  All-true means
+        the node can serve traffic (the reference's check_ready polls
+        clocksi tables + read servers + materializer + stable meta)."""
+        probes = {}
+        probes["types"] = bool(is_type("counter_pn"))
+        try:
+            probes["meta"] = self.meta.get_env("txn_prot", "clocksi") in (
+                "clocksi", "gr")
+        except Exception:
+            probes["meta"] = False
+        try:
+            self.store.stable_vc()
+            probes["clocks"] = True
+        except Exception:
+            probes["clocks"] = False
+        if self.store.log is not None:
+            try:
+                self.store.log.commit_barrier([0])
+                probes["log"] = True
+            except Exception:
+                probes["log"] = False
+        else:
+            probes["log"] = True  # ephemeral mode: nothing to probe
+        metrics, self.txm.metrics = self.txm.metrics, None
+        try:
+            # full txn machinery + device round trip, then rolled back —
+            # also warms the jit caches (first TPU compile is ~20-40 s,
+            # better here than on the first client request).  Metrics are
+            # detached so health polling never skews op/abort dashboards;
+            # the aborted probe txn binds no rows (reads of never-written
+            # keys allocate nothing, commits never happen).
+            txn = self.start_transaction()
+            self.update_objects(
+                [("__ready__", "counter_pn", "__ready__", ("increment", 1))],
+                txn)
+            self.read_objects([("__ready__", "counter_pn", "__ready__")], txn)
+            self.abort_transaction(txn)
+            probes["txn"] = True
+        except Exception:
+            logging.getLogger("antidote_tpu").exception("readiness probe")
+            probes["txn"] = False
+        finally:
+            self.txm.metrics = metrics
+        return probes
+
+    def is_ready(self) -> bool:
+        return all(self.check_ready().values())
+
+    def status(self, include_ready: bool = False) -> dict:
+        """Operator-facing snapshot (the console's `status` command).
+
+        Passive by default — ``include_ready=True`` additionally runs the
+        full readiness probe (a device round trip + WAL barrier), which is
+        too heavy for high-frequency monitoring polls."""
+        stable = self.store.stable_vc()
+        out = {
+            "dc_id": self.dc_id,
+            "n_shards": self.cfg.n_shards,
+            "max_dcs": self.cfg.max_dcs,
+            "protocol": self.txm.protocol,
+            "certification": self.txm.cert,
+            "stable_vc": [int(x) for x in stable],
+            "commit_counter": int(self.txm.commit_counter),
+            "keys": len(self.store.directory),
+            "tables": {
+                t: {"rows_used": int(tab.used_rows.sum()),
+                    "n_rows": tab.n_rows}
+                for t, tab in self.store.tables.items()
+            },
+            "durable": self.store.log is not None,
+        }
+        if include_ready:
+            out["ready"] = self.check_ready()
+        return out
+
     # --- shard handoff (riak_core handoff receiver) ---------------------
     def receive_handoff(self, pkg, shard: Optional[int] = None) -> None:
         """Install an exported shard package (see store/handoff.py) and

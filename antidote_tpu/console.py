@@ -1,0 +1,189 @@
+"""Operator console — the release entrypoint and admin CLI.
+
+The analogue of the reference's release script + ``antidote_console``
+(/root/reference/src/antidote_console.erl:34-50) and its riak-admin
+commands: ``serve`` boots a node the way the OTP release does (WAL,
+recovery, wire protocol, metrics endpoint, readiness gate), and the other
+commands operate a running node over the client protocol or inspect a WAL
+directory offline.
+
+    python -m antidote_tpu.console serve --log-dir /data/dc0 --port 8087
+    python -m antidote_tpu.console status --port 8087
+    python -m antidote_tpu.console ready --port 8087
+    python -m antidote_tpu.console read  --port 8087 KEY TYPE BUCKET
+    python -m antidote_tpu.console update --port 8087 KEY TYPE BUCKET OP ARG
+    python -m antidote_tpu.console inspect --log-dir /data/dc0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _parse_arg(raw: str):
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw
+
+
+def cmd_serve(args) -> int:
+    import os
+
+    # honor JAX_PLATFORMS through jax.config BEFORE any jax op: plugin
+    # discovery can probe unavailable accelerator backends (and hang on a
+    # dead tunnel) even when the env var says cpu
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    from antidote_tpu.api import AntidoteNode
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.proto.server import ProtocolServer
+
+    cfg = AntidoteConfig(n_shards=args.shards, max_dcs=args.max_dcs)
+    has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and any(
+        f.endswith(".wal") and os.path.getsize(os.path.join(args.log_dir, f)) > 0
+        for f in os.listdir(args.log_dir)
+    )
+    recover = args.recover or has_wal_data
+    node = AntidoteNode(cfg, dc_id=args.dc_id, log_dir=args.log_dir,
+                        recover=recover)
+    probes = node.check_ready()
+    if not all(probes.values()):
+        log(f"NOT READY: {probes}")
+        return 1
+    server = ProtocolServer(node, host=args.host, port=args.port)
+    if args.metrics_port is not None:
+        node.serve_metrics(args.metrics_port)
+    log(f"antidote_tpu dc{args.dc_id} serving on "
+        f"{server.host}:{server.port} (recovered={recover}, "
+        f"keys={len(node.store.directory)})")
+    print(json.dumps({"host": server.host, "port": server.port,
+                      "ready": True}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log("shutting down")
+        server.close()
+    return 0
+
+
+def _client(args):
+    from antidote_tpu.proto.client import AntidoteClient
+
+    return AntidoteClient(args.host, args.port)
+
+
+def cmd_status(args) -> int:
+    c = _client(args)
+    print(json.dumps(c.node_status(), indent=2))
+    c.close()
+    return 0
+
+
+def cmd_ready(args) -> int:
+    c = _client(args)
+    ready = c.node_status(include_ready=True)["ready"]
+    print(json.dumps(ready))
+    c.close()
+    return 0 if all(ready.values()) else 1
+
+
+def cmd_read(args) -> int:
+    c = _client(args)
+    vals, vc = c.read_objects([(args.key, args.type, args.bucket)])
+    print(json.dumps({"value": vals[0], "clock": list(vc)}, default=str))
+    c.close()
+    return 0
+
+
+def cmd_update(args) -> int:
+    c = _client(args)
+    vc = c.update_objects(
+        [(args.key, args.type, args.bucket, (args.op, _parse_arg(args.arg)))]
+    )
+    print(json.dumps({"commit_clock": list(vc)}))
+    c.close()
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """Offline WAL inspection (log_recovery debugging aid)."""
+    import glob
+    import os
+
+    from antidote_tpu.log.wal import replay
+
+    out = {}
+    for path in sorted(glob.glob(os.path.join(args.log_dir, "shard_*.wal"))):
+        shard = os.path.basename(path)
+        recs = keys = 0
+        chains: dict = {}
+        types: dict = {}
+        for rec in replay(path):
+            recs += 1
+            o = int(rec["o"])
+            chains[o] = max(chains.get(o, 0), int(rec["id"]))
+            types[rec["t"]] = types.get(rec["t"], 0) + 1
+        out[shard] = {"records": recs, "opid_chains": chains,
+                      "records_by_type": types,
+                      "bytes": os.path.getsize(path)}
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="antidote_tpu.console")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sv = sub.add_parser("serve", help="boot a node and serve the protocol")
+    sv.add_argument("--log-dir", default=None)
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8087)
+    sv.add_argument("--metrics-port", type=int, default=None)
+    sv.add_argument("--dc-id", type=int, default=0)
+    sv.add_argument("--shards", type=int, default=16)
+    sv.add_argument("--max-dcs", type=int, default=8)
+    sv.add_argument("--recover", action="store_true")
+    sv.set_defaults(fn=cmd_serve)
+
+    for name, fn in (("status", cmd_status), ("ready", cmd_ready)):
+        p = sub.add_parser(name)
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", type=int, default=8087)
+        p.set_defaults(fn=fn)
+
+    rd = sub.add_parser("read")
+    rd.add_argument("--host", default="127.0.0.1")
+    rd.add_argument("--port", type=int, default=8087)
+    rd.add_argument("key"), rd.add_argument("type"), rd.add_argument("bucket")
+    rd.set_defaults(fn=cmd_read)
+
+    up = sub.add_parser("update")
+    up.add_argument("--host", default="127.0.0.1")
+    up.add_argument("--port", type=int, default=8087)
+    up.add_argument("key"), up.add_argument("type"), up.add_argument("bucket")
+    up.add_argument("op"), up.add_argument("arg")
+    up.set_defaults(fn=cmd_update)
+
+    ins = sub.add_parser("inspect", help="offline WAL inspection")
+    ins.add_argument("--log-dir", required=True)
+    ins.set_defaults(fn=cmd_inspect)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,5 +101,13 @@ class AntidoteClient:
         return self._call(MessageCode.GET_CONNECTION_DESCRIPTOR,
                           {})["descriptor"]
 
+    def node_status(self, include_ready: bool = False) -> dict:
+        """Operator snapshot (console `status`; no reference pb
+        equivalent — the reference exposes this via riak-admin/console).
+        ``include_ready`` additionally runs the server-side readiness
+        probe (heavier: device round trip + WAL barrier)."""
+        return self._call(MessageCode.NODE_STATUS,
+                          {"include_ready": include_ready})["status"]
+
     def close(self) -> None:
         self._sock.close()

@@ -30,6 +30,7 @@ class MessageCode(enum.IntEnum):
     GET_CONNECTION_DESCRIPTOR = 8
     CONNECT_TO_DCS = 9
     CREATE_DC = 10
+    NODE_STATUS = 11  # console/ops extension (no reference pb equivalent)
     # responses
     OPERATION_RESP = 64
     START_TRANSACTION_RESP = 65

@@ -161,6 +161,12 @@ class ProtocolServer:
                                "n_shards": d.n_shards,
                                "address": d.address},
             }
+        if code == MessageCode.NODE_STATUS:
+            return MessageCode.OPERATION_RESP, {
+                "status": node.status(
+                    include_ready=bool(body.get("include_ready"))
+                )
+            }
         raise ValueError(f"unhandled message code {code!r}")
 
     def _txn(self, txid: int) -> Transaction:

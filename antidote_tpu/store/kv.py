@@ -192,11 +192,20 @@ class KVStore:
         read VC (grouped by type into batched device folds)."""
         read_vc = np.asarray(read_vc, np.int32)
         by_type: Dict[str, list] = {}
-        self.locate_many(objects)
-        for i, (key, type_name, bucket) in enumerate(objects):
-            _, shard, row = self.locate(key, type_name, bucket)
-            by_type.setdefault(type_name, []).append((i, shard, row))
         out: List[Dict[str, np.ndarray] | None] = [None] * len(objects)
+        for i, (key, type_name, bucket) in enumerate(objects):
+            ent = self.locate(key, type_name, bucket, create=False)
+            if ent is None:
+                # never-written key: the bottom state (Type:new()), no row
+                # allocated — reads must not grow the tables
+                ty = get_type(type_name)
+                out[i] = {
+                    f: np.zeros(shape, dtype)
+                    for f, (shape, dtype) in ty.state_spec(self.cfg).items()
+                }
+                continue
+            _, shard, row = ent
+            by_type.setdefault(type_name, []).append((i, shard, row))
         for type_name, items in by_type.items():
             t = self.table(type_name)
             shards = np.asarray([x[1] for x in items], np.int64)

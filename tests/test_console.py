@@ -1,0 +1,127 @@
+"""Console CLI + readiness probe (antidote_console / wait_init analogues,
+/root/reference/src/antidote_console.erl:34-50,
+/root/reference/src/wait_init.erl:50-88)."""
+
+import json
+import threading
+
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.proto.server import ProtocolServer
+
+
+@pytest.fixture
+def node(cfg):
+    return AntidoteNode(cfg)
+
+
+def test_check_ready_all_probes(node):
+    probes = node.check_ready()
+    assert set(probes) == {"types", "meta", "clocks", "log", "txn"}
+    assert all(probes.values()), probes
+    assert node.is_ready()
+
+
+def test_ready_probe_leaves_no_state(node):
+    node.check_ready()
+    # the probe txn aborts: nothing committed, no value visible, and —
+    # critically — no directory binding or table row allocated (reads of
+    # never-written keys must not grow the tables or leak into handoffs)
+    vals, _ = node.read_objects([("__ready__", "counter_pn", "__ready__")])
+    assert vals == [0]
+    assert node.store.locate("__ready__", "counter_pn", "__ready__",
+                             create=False) is None
+    assert len(node.store.directory) == 0
+    # and the probe never skews op/abort dashboards
+    assert node.metrics.aborted_transactions.value() == 0
+    assert node.metrics.operations.value(type="update") == 0
+
+
+def test_status_snapshot(node):
+    node.update_objects([("k", "counter_pn", "b", ("increment", 2))])
+    st = node.status()
+    assert st["n_shards"] == node.cfg.n_shards
+    assert st["keys"] >= 1
+    assert st["tables"]["counter_pn"]["rows_used"] >= 1
+    assert st["commit_counter"] == 1
+    assert "ready" not in st  # passive by default (monitoring-poll safe)
+    assert all(node.status(include_ready=True)["ready"].values())
+
+
+def test_status_over_wire(node):
+    from antidote_tpu.proto.client import AntidoteClient
+
+    server = ProtocolServer(node, port=0)
+    try:
+        c = AntidoteClient(server.host, server.port)
+        st = c.node_status(include_ready=True)
+        assert st["dc_id"] == node.dc_id and all(st["ready"].values())
+        c.close()
+    finally:
+        server.close()
+
+
+def test_console_status_read_update(node, capsys):
+    from antidote_tpu import console
+
+    server = ProtocolServer(node, port=0)
+    try:
+        base = ["--host", server.host, "--port", str(server.port)]
+        assert console.main(["update", *base, "k", "counter_pn", "b",
+                             "increment", "5"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "commit_clock" in out
+        assert console.main(["read", *base, "k", "counter_pn", "b"]) == 0
+        assert json.loads(capsys.readouterr().out)["value"] == 5
+        assert console.main(["status", *base]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["keys"] >= 1
+        assert console.main(["ready", *base]) == 0
+    finally:
+        server.close()
+
+
+def test_release_smoke(tmp_path):
+    """The reference's release smoke test (make reltest,
+    /root/reference/test/release_test.sh:1-16): boot the release entrypoint
+    as a real subprocess, run one txn via the client, stop it."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", "2", "--log-dir", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    try:
+        line = proc.stdout.readline()  # blocks until the ready line
+        info = json.loads(line)
+        assert info["ready"] is True
+        from antidote_tpu.proto.client import AntidoteClient
+
+        c = AntidoteClient(info["host"], info["port"])
+        c.update_objects([("k", "counter_pn", "b", ("increment", 9))])
+        vals, _ = c.read_objects([("k", "counter_pn", "b")])
+        assert vals == [9]
+        assert all(c.node_status(include_ready=True)["ready"].values())
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_console_inspect(tmp_path, cfg, capsys):
+    from antidote_tpu import console
+
+    node = AntidoteNode(cfg, log_dir=str(tmp_path))
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1)),
+                         ("s", "set_aw", "b", ("add", "x"))])
+    assert console.main(["inspect", "--log-dir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    total = sum(s["records"] for s in out.values())
+    assert total == 2
+    assert any("counter_pn" in s["records_by_type"] for s in out.values())
