@@ -52,6 +52,13 @@ class AntidoteConfig:
     enable_logging: bool = True
     sync_log: bool = False
 
+    # --- kernels --------------------------------------------------------
+    #: dispatch the materializer hot loops to the hand-tiled Pallas TPU
+    #: kernels (materializer/pallas_kernels.py) where a type-specific fused
+    #: kernel exists (counter fold, OR-set presence, stable-VC min); the
+    #: generic XLA scan fold remains the fallback and the semantics oracle
+    use_pallas: bool = False
+
     # --- misc ----------------------------------------------------------
     #: store a fresh snapshot version only if at least this many ops were
     #: folded (?MIN_OP_STORE_SS=5, include/antidote.hrl:47)

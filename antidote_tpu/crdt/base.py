@@ -107,6 +107,45 @@ class CRDTType(abc.ABC):
         materializer scan (Type:update/2,
         /root/reference/src/materializer.erl:51-58)."""
 
+    # ---- device-side value resolution (serving fast path) --------------
+    #: how many value lanes ``resolve`` compacts multi-element values into;
+    #: keys with more present elements than this report the true count and
+    #: the caller re-fetches the full state (rare — Antidote sets/maps are
+    #: small per key)
+    resolve_top = 4
+
+    def resolve_spec(self, cfg: AntidoteConfig):
+        """Layout of the compact device-resolved value view:
+        name -> (per-key shape suffix, dtype), or ``None`` when the type has
+        no device resolution (callers fall back to the host ``value``).
+
+        This is the device analogue of ``Type:value`` in the batched read
+        path (cure:transform_reads, /root/reference/src/cure.erl:186-192):
+        instead of shipping full per-key state host-side and decoding in
+        Python, the resolution runs on device and only the compact view
+        crosses the PCIe/tunnel boundary."""
+        return None
+
+    def resolve(self, cfg: AntidoteConfig, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Batched device value resolution: ``state`` fields carry arbitrary
+        leading batch dims; returns arrays per ``resolve_spec``.  Pure JAX,
+        traced inside the serving read kernel."""
+        raise NotImplementedError(f"{self.name} has no device resolution")
+
+
+def compact_top(elems, present, top: int):
+    """Compact a slotted multi-element value view on device.
+
+    ``elems`` i64[..., E], ``present`` bool[..., E] → (``top_elems``
+    i64[..., top] — the first ``top`` present elements, zero-padded —
+    and ``count`` i32[...], the true presence count).  Callers re-fetch
+    the full state for keys whose count exceeds ``top``."""
+    import jax.numpy as jnp
+
+    order = jnp.argsort(~present, axis=-1, stable=True)[..., :top]
+    top_elems = jnp.take_along_axis(jnp.where(present, elems, 0), order, axis=-1)
+    return top_elems, present.sum(-1).astype(jnp.int32)
+
 
 def pack_a(*vals: int, width: int) -> np.ndarray:
     out = np.zeros((width,), dtype=np.int64)

@@ -58,6 +58,12 @@ class CounterPN(CRDTType):
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         return {"cnt": state["cnt"] + eff_a[0]}
 
+    def resolve_spec(self, cfg):
+        return {"value": ((), jnp.int64)}
+
+    def resolve(self, cfg, state):
+        return {"value": state["cnt"]}
+
 
 class CounterFat(CRDTType):
     """PN counter with reset ("fat" counter).
@@ -113,6 +119,12 @@ class CounterFat(CRDTType):
 
     def value(self, state, blobs, cfg):
         return int(np.sum(np.asarray(state["amt"])))
+
+    def resolve_spec(self, cfg):
+        return {"value": ((), jnp.int64)}
+
+    def resolve(self, cfg, state):
+        return {"value": jnp.sum(state["amt"], axis=-1)}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs

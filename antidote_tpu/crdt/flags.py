@@ -85,6 +85,13 @@ class FlagEW(_FlagAssocMixin, _FlagBase):
     def value(self, state, blobs, cfg):
         return bool(np.any(np.asarray(state["envc"]) > np.asarray(state["disvc"])))
 
+    def resolve_spec(self, cfg):
+        return {"value": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        on = jnp.any(state["envc"] > state["disvc"], axis=-1)
+        return {"value": on.astype(jnp.int32)}
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
         envc, disvc = state["envc"], state["disvc"]
@@ -126,6 +133,14 @@ class FlagDW(_FlagAssocMixin, _FlagBase):
         envc = np.asarray(state["envc"])
         disvc = np.asarray(state["disvc"])
         return bool(np.any(envc > 0) and np.all(envc >= disvc))
+
+    def resolve_spec(self, cfg):
+        return {"value": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        envc, disvc = state["envc"], state["disvc"]
+        on = jnp.any(envc > 0, axis=-1) & jnp.all(envc >= disvc, axis=-1)
+        return {"value": on.astype(jnp.int32)}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs

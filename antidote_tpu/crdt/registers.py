@@ -55,6 +55,13 @@ class RegisterLWW(CRDTType):
     def value(self, state, blobs, cfg):
         return blobs.resolve(int(state["val"]))
 
+    def resolve_spec(self, cfg):
+        return {"value": ((), jnp.int64)}
+
+    def resolve(self, cfg, state):
+        # the handle; the host resolves it to the payload via the blob store
+        return {"value": state["val"]}
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         h, ts = eff_a[0], eff_a[1]
         newer = (ts > state["ts"]) | ((ts == state["ts"]) & (h > state["val"]))
@@ -115,6 +122,18 @@ class RegisterMV(CRDTType):
         ids = np.asarray(state["ids"])
         out = [blobs.resolve(int(v)) for v, i in zip(vals, ids) if i != 0]
         return sorted(out, key=repr)
+
+    def resolve_spec(self, cfg):
+        t = self.resolve_top
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        from antidote_tpu.crdt.base import compact_top
+
+        top, count = compact_top(
+            state["vals"], state["ids"] != 0, self.resolve_top
+        )
+        return {"top": top, "count": count}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         k = cfg.mv_slots

@@ -29,7 +29,7 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
-from antidote_tpu.crdt.base import CRDTType, Effect, pack_b
+from antidote_tpu.crdt.base import CRDTType, Effect, compact_top, pack_b
 from antidote_tpu.crdt.blob import EMPTY_HANDLE
 
 
@@ -110,6 +110,37 @@ class SetAW(CRDTType):
             np.asarray(state["addvc"]) > np.asarray(state["rmvc"]), axis=-1
         ) & (elems != EMPTY_HANDLE)
         return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
+
+    def resolve_spec(self, cfg):
+        t = self.resolve_top
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        """Device OR-set presence + compaction.  With ``cfg.use_pallas`` the
+        presence comparison runs as the fused Pallas kernel
+        (materializer/pallas_kernels.py::orset_presence) — the in-path
+        dispatch VERDICT asked for; the plain-XLA comparison is the
+        fallback."""
+        elems = state["elems"]
+        if getattr(cfg, "use_pallas", False):
+            from antidote_tpu.materializer import pallas_kernels as pk
+
+            lead = elems.shape[:-1]
+            e = elems.shape[-1]
+            # occupancy in i32 lanes: fold the high word in so a handle
+            # whose low 32 bits happen to be zero still reads occupied
+            occ = (elems | (elems >> 32)).reshape((-1, e)).astype(jnp.int32)
+            pres_i = pk.orset_presence(
+                state["addvc"].reshape((-1, e, cfg.max_dcs)),
+                state["rmvc"].reshape((-1, e, cfg.max_dcs)),
+                occ,
+            )
+            present = pres_i.reshape(lead + (e,)) > 0
+        else:
+            present = jnp.any(state["addvc"] > state["rmvc"], axis=-1)
+            present = present & (elems != EMPTY_HANDLE)
+        top, count = compact_top(elems, present, self.resolve_top)
+        return {"top": top, "count": count}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
@@ -210,6 +241,18 @@ class SetRW(CRDTType):
         present = self._present(elems, state["addvc"], state["rmvc"])
         return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
 
+    def resolve_spec(self, cfg):
+        t = self.resolve_top
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
+        has_add = jnp.any(addvc > 0, axis=-1)
+        covered = jnp.all(addvc >= rmvc, axis=-1)
+        present = (elems != EMPTY_HANDLE) & has_add & covered
+        top, count = compact_top(elems, present, self.resolve_top)
+        return {"top": top, "count": count}
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
         elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
@@ -282,6 +325,15 @@ class SetGO(CRDTType):
         return sorted(
             (blobs.resolve(int(h)) for h in elems[elems != EMPTY_HANDLE]), key=repr
         )
+
+    def resolve_spec(self, cfg):
+        t = self.resolve_top
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+
+    def resolve(self, cfg, state):
+        elems = state["elems"]
+        top, count = compact_top(elems, elems != EMPTY_HANDLE, self.resolve_top)
+        return {"top": top, "count": count}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         elems = state["elems"]

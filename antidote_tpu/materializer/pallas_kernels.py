@@ -33,7 +33,8 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
 def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+    # "axon" is this environment's tunneled TPU PJRT plugin
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def _pad_to(x, mult, axis, fill=0):

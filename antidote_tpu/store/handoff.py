@@ -42,6 +42,11 @@ def _table_slice(t, shard: int, used: int) -> Dict[str, Any]:
         "n_ops": t.n_ops[shard, :used].copy(),
         "head": {f: np.asarray(x[shard, :used]) for f, x in t.head.items()},
         "head_vc": np.asarray(t.head_vc[shard, :used]),
+        # host-tracked serving-path gates (table-wide, conservative): the
+        # importer must inherit them or the Pallas counter dispatch /
+        # provably-fresh fast path would trust stale bounds
+        "max_abs_delta": int(t.max_abs_delta),
+        "max_commit_vc": t.max_commit_vc.copy(),
     }
     return out
 
@@ -149,6 +154,20 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
         t.head_vc = t.head_vc.at[dst, base:end].set(sl["head_vc"])
         t.n_ops[dst, base:end] = sl["n_ops"]
         t.used_rows[dst] = end
+        # packages from builds predating these gates lack the keys; the
+        # conservative defaults disable the Pallas counter dispatch and the
+        # provably-fresh fast path rather than trusting stale bounds
+        t.max_abs_delta = max(
+            t.max_abs_delta, int(sl.get("max_abs_delta", 2**62))
+        )
+        np.maximum(
+            t.max_commit_vc,
+            np.asarray(
+                sl.get("max_commit_vc", np.full_like(t.max_commit_vc, 2**31 - 1)),
+                np.int32,
+            ),
+            out=t.max_commit_vc,
+        )
     for key, bucket, tname, row in pkg["directory"]:
         store.directory[(freeze_key(key), bucket)] = (
             tname, dst, bases[tname] + int(row)
@@ -287,6 +306,9 @@ def reshard(store: KVStore, new_cfg, log=None) -> KVStore:
             np.asarray(src.head_vc)[old_s, old_r])
         dst.n_ops[ns, nr] = src.n_ops[old_s, old_r]
         dst.next_seq = max(dst.next_seq, src.next_seq)
+        dst.max_abs_delta = max(dst.max_abs_delta, src.max_abs_delta)
+        np.maximum(dst.max_commit_vc, src.max_commit_vc,
+                   out=dst.max_commit_vc)
         for i, (dk, _, _, _) in enumerate(ents):
             new.directory[dk] = (tname, int(ns[i]), int(nr[i]))
 

@@ -24,6 +24,24 @@ from antidote_tpu.store.typed_table import TypedTable
 
 BoundObject = Tuple[Any, str, str]  # (key, type_name, bucket)
 
+#: below this many clock rows the host numpy min beats a device launch
+_PALLAS_MIN_ROWS = 2048
+
+
+def stable_min_of(clock_rows: np.ndarray, use_pallas: bool = False) -> np.ndarray:
+    """Entry-wise min over a clock matrix ``i32[N, D]`` — the stable-time
+    merge for ANY collection of per-shard / per-node clocks
+    (stable_time_functions:get_min_time,
+    /root/reference/src/stable_time_functions.erl:51-85).  Large matrices
+    (multi-node aggregation: nodes × shards rows) dispatch to the streaming
+    Pallas kernel; small ones stay on host."""
+    clock_rows = np.asarray(clock_rows)
+    if use_pallas and clock_rows.shape[0] >= _PALLAS_MIN_ROWS:
+        from antidote_tpu.materializer import pallas_kernels as pk
+
+        return np.asarray(pk.stable_min(clock_rows))
+    return clock_rows.min(axis=0)
+
 
 def freeze_key(key: Any) -> Any:
     """Normalize a key after wire/log deserialization: msgpack returns
@@ -81,6 +99,8 @@ class KVStore:
         # DC's stable snapshot (stable_time_functions:get_min_time,
         # /root/reference/src/stable_time_functions.erl:51-85)
         self.applied_vc = np.zeros((cfg.n_shards, cfg.max_dcs), np.int32)
+        #: per-type cached bottom (never-written) resolved view
+        self._bottom_cache: Dict[str, Dict[str, np.ndarray]] = {}
 
     # ------------------------------------------------------------------
     def table(self, type_name: str) -> TypedTable:
@@ -242,6 +262,81 @@ class KVStore:
                 out[i] = {f: x[j] for f, x in state.items()}
         return out  # type: ignore[return-value]
 
+    def _bottom_resolved(self, type_name: str) -> Dict[str, np.ndarray]:
+        """The resolved view of a never-written key (Type:new()) — constant
+        per type, computed once and copied, never a per-key device launch."""
+        hit = self._bottom_cache.get(type_name)
+        if hit is None:
+            ty = get_type(type_name)
+            zero = {
+                f: np.zeros(shape, dtype)
+                for f, (shape, dtype) in ty.state_spec(self.cfg).items()
+            }
+            if ty.resolve_spec(self.cfg) is not None:
+                hit = {
+                    f: np.asarray(x)
+                    for f, x in ty.resolve(self.cfg, zero).items()
+                }
+            else:
+                hit = zero
+            self._bottom_cache[type_name] = hit
+        return {f: x.copy() for f, x in hit.items()}
+
+    def read_resolved(
+        self, objects: Sequence[BoundObject], read_vc: np.ndarray
+    ) -> List[Dict[str, np.ndarray]]:
+        """Serving fast path: batched reads with DEVICE value resolution.
+
+        One launch per touched type does freshness check + versioned fold +
+        ``Type.resolve`` compaction (TypedTable.read_resolved); only the
+        compact value view crosses the host boundary — the batched,
+        device-resident rendering of the read path in SURVEY §3.3
+        (materializer_vnode:read + cure:transform_reads).  Types without a
+        ``resolve_spec`` return their full state; rows below retained
+        device coverage fall back to the host log replay + host-side
+        resolution."""
+        read_vc = np.asarray(read_vc, np.int32)
+        out: List[Dict[str, np.ndarray] | None] = [None] * len(objects)
+        by_type: Dict[str, list] = {}
+        for i, (key, type_name, bucket) in enumerate(objects):
+            ent = self.locate(key, type_name, bucket, create=False)
+            if ent is None:
+                out[i] = self._bottom_resolved(type_name)
+                continue
+            _, shard, row = ent
+            by_type.setdefault(type_name, []).append((i, shard, row))
+        for type_name, items in by_type.items():
+            t = self.table(type_name)
+            ty = t.ty
+            shards = np.asarray([x[1] for x in items], np.int64)
+            rows = np.asarray([x[2] for x in items], np.int64)
+            vcs = np.broadcast_to(read_vc, (len(items), read_vc.shape[-1]))
+            resolved, _, complete = t.read_resolved(shards, rows, vcs)
+            for j, (i, _, _) in enumerate(items):
+                out[i] = {f: x[j] for f, x in resolved.items()}
+            if not complete.all():
+                # host log-replay fallback + host-side resolution
+                bad = [j for j in np.nonzero(~complete)[0]]
+                by_shard: Dict[int, list] = {}
+                for j in bad:
+                    gi = items[j][0]
+                    key, tname, bucket = objects[gi]
+                    by_shard.setdefault(items[j][1], []).append(
+                        (int(j), key, tname, bucket)
+                    )
+                for shard, wants in by_shard.items():
+                    reps = self._replay_read_many(shard, wants, read_vc)
+                    for j, rep in reps.items():
+                        gi = items[j][0]
+                        if ty.resolve_spec(self.cfg) is not None:
+                            out[gi] = {
+                                f: np.asarray(x)
+                                for f, x in ty.resolve(self.cfg, rep).items()
+                            }
+                        else:
+                            out[gi] = rep
+        return out  # type: ignore[return-value]
+
     def read_values(
         self, objects: Sequence[BoundObject], read_vc: np.ndarray
     ) -> List[Any]:
@@ -339,7 +434,11 @@ class KVStore:
             self.log = log
 
     def stable_vc(self) -> np.ndarray:
-        """DC-wide stable snapshot = entry-wise min of per-shard clocks."""
+        """DC-wide stable snapshot = entry-wise min of per-shard clocks
+        (stable_time_functions:get_min_time,
+        /root/reference/src/stable_time_functions.erl:51-85).  At
+        ``n_shards`` rows the host min always wins; the large-matrix min
+        (many nodes × shards) goes through :func:`stable_min_of`."""
         return self.applied_vc.min(axis=0)
 
     def dc_max_vc(self) -> np.ndarray:

@@ -39,7 +39,7 @@ caller falls back to a host-side log replay, mirroring the reference's
 from __future__ import annotations
 
 import functools
-from typing import Dict, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,11 +119,12 @@ def _shard_read_latest_body(ty, cfg):
     return read
 
 
-def _shard_read_body(ty, cfg):
-    """Per-shard read kernel: operates on one shard's block."""
+def _shard_base_select_body(ty, cfg):
+    """Per-shard snapshot-version selection: the newest retained version
+    dominated by each read VC becomes the fold base (vector_orddict
+    get_smaller, /root/reference/src/vector_orddict.erl:74-87)."""
 
-    def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
-             rows, n_ops_rows, read_vcs):
+    def select(snap, snap_vc, snap_seq, rows, read_vcs):
         svc = snap_vc[rows]            # [M, V, D]
         sseq = snap_seq[rows]          # [M, V]
         idx, found = orddict.get_smaller(svc, sseq, read_vcs)
@@ -138,11 +139,6 @@ def _shard_read_body(ty, cfg):
             )
             for f, x in snap.items()
         }
-        state, applied = fold_mod.fold_batch(
-            ty, cfg, base_state,
-            ops_a[rows], ops_b[rows], ops_vc[rows], ops_origin[rows],
-            n_ops_rows, base_vc, read_vcs,
-        )
         # complete ⟺ the key was never GC'd (ring holds its whole history),
         # or the selected base is the NEWEST retained version — the ring
         # only holds ops after the newest version, so folding onto an older
@@ -151,6 +147,26 @@ def _shard_read_body(ty, cfg):
         newest = jnp.max(sseq, axis=-1)
         picked_newest = found & (sseq[take, idx] == newest)
         complete = picked_newest | never_gcd
+        return base_state, base_vc, complete
+
+    return select
+
+
+def _shard_read_body(ty, cfg):
+    """Per-shard read kernel: operates on one shard's block."""
+
+    select = _shard_base_select_body(ty, cfg)
+
+    def read(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
+             rows, n_ops_rows, read_vcs):
+        base_state, base_vc, complete = select(
+            snap, snap_vc, snap_seq, rows, read_vcs
+        )
+        state, applied = fold_mod.fold_batch(
+            ty, cfg, base_state,
+            ops_a[rows], ops_b[rows], ops_vc[rows], ops_origin[rows],
+            n_ops_rows, base_vc, read_vcs,
+        )
         return state, applied, complete
 
     return read
@@ -174,6 +190,18 @@ class TypedTable:
         self.sharding = sharding
         self.used_rows = np.zeros((self.n_shards,), np.int64)
         self.next_seq = 1
+        self._resolved_fns: Dict[bool, Any] = {}
+        # host-tracked bound on |eff_a lane 0| — gates the i32 Pallas
+        # counter-fold dispatch without any device readback (the r1 advisor
+        # flagged the per-call jnp.abs().max() guard as a blocking sync)
+        self.max_abs_delta = 0
+        # host-tracked entry-wise max over all appended commit VCs: a read
+        # VC dominating this makes EVERY row fresh, so the serving read can
+        # skip the versioned fold without any device round trip (the
+        # common read-at-current-VC case — the reference's reads also take
+        # the cached-snapshot fast path when nothing concurrent is
+        # prepared, /root/reference/src/materializer_vnode.erl:382-413)
+        self.max_commit_vc = np.zeros((cfg.max_dcs,), np.int32)
         d, v, k = cfg.max_dcs, cfg.snap_versions, cfg.ops_per_key
         a, b = ty.eff_a_width(cfg), ty.eff_b_width(cfg)
         p, n = self.n_shards, self.n_rows
@@ -314,6 +342,93 @@ class TypedTable:
 
         return read
 
+    @functools.cached_property
+    def _latest_resolved_fn(self):
+        """Fold-free serving read for read VCs that dominate every commit
+        this table has seen (host-decided via ``max_commit_vc``): head
+        gather + device value resolution only."""
+        ty, cfg = self.ty, self.cfg
+        latest = _shard_read_latest_body(ty, cfg)
+
+        @jax.jit
+        def fn(head, head_vc, rows, read_vcs):
+            state, fresh = jax.vmap(latest)(head, head_vc, rows, read_vcs)
+            resolved = (
+                ty.resolve(cfg, state)
+                if ty.resolve_spec(cfg) is not None
+                else state
+            )
+            return resolved, fresh
+
+        return fn
+
+    def _read_resolved_fn(self, pallas_counter: bool):
+        """The fused serving read: head gather + snapshot-version select +
+        versioned ring fold + freshness select + device value resolution,
+        all in ONE launch — the whole read path of SURVEY §3.3
+        (check-freshness ≈ check_clock, fold ≈ clocksi_materializer:
+        materialize, resolution ≈ Type:value) without intermediate host
+        round trips.  ``pallas_counter`` dispatches the counter-family fold
+        to the fused Pallas masked-sum kernel (VERDICT r1 item 3)."""
+        cached = self._resolved_fns.get(pallas_counter)
+        if cached is not None:
+            return cached
+        ty, cfg = self.ty, self.cfg
+        latest = _shard_read_latest_body(ty, cfg)
+        select = _shard_base_select_body(ty, cfg)
+
+        @jax.jit
+        def fn(head, head_vc, snap, snap_vc, snap_seq,
+               ops_a, ops_b, ops_vc, ops_origin, rows, n_ops_rows, read_vcs):
+            state_h, fresh = jax.vmap(latest)(head, head_vc, rows, read_vcs)
+            base_state, base_vc, complete = jax.vmap(select)(
+                snap, snap_vc, snap_seq, rows, read_vcs
+            )
+            gat = jax.vmap(lambda x, r: x[r])
+            opa, opv = gat(ops_a, rows), gat(ops_vc, rows)
+            if pallas_counter:
+                from antidote_tpu.materializer import pallas_kernels as pk
+
+                p, m = rows.shape
+                k, d = opv.shape[2], opv.shape[3]
+                dcnt, applied = pk._counter_fold_call(
+                    opa[..., 0].reshape(p * m, k).astype(jnp.int32),
+                    opv.reshape(p * m, k, d),
+                    n_ops_rows.reshape(p * m),
+                    base_vc.reshape(p * m, d),
+                    read_vcs.reshape(p * m, d),
+                    256, not pk._on_tpu(),
+                )
+                state_f = {
+                    "cnt": base_state["cnt"]
+                    + dcnt.astype(jnp.int64).reshape(p, m)
+                }
+                applied = applied.reshape(p, m)
+            else:
+                opb, opo = gat(ops_b, rows), gat(ops_origin, rows)
+                state_f, applied = jax.vmap(
+                    lambda s, a, b, v, o, n, bv, rv: fold_mod.fold_batch(
+                        ty, cfg, s, a, b, v, o, n, bv, rv
+                    )
+                )(base_state, opa, opb, opv, opo, n_ops_rows, base_vc, read_vcs)
+            state = {
+                f: jnp.where(
+                    fresh.reshape(fresh.shape + (1,) * (x.ndim - 2)),
+                    state_h[f], x,
+                )
+                for f, x in state_f.items()
+            }
+            complete = complete | fresh
+            resolved = (
+                ty.resolve(cfg, state)
+                if ty.resolve_spec(cfg) is not None
+                else state
+            )
+            return resolved, fresh, complete
+
+        self._resolved_fns[pallas_counter] = fn
+        return fn
+
     # ------------------------------------------------------------------
     # host routing helpers
     # ------------------------------------------------------------------
@@ -376,6 +491,16 @@ class TypedTable:
                     f"more than {k} ops for one key in a single batch; "
                     f"split the batch (type={self.ty.name})"
                 )
+        eff_a = np.asarray(eff_a, np.int64)
+        if m and eff_a.shape[1] > 0:
+            self.max_abs_delta = max(
+                self.max_abs_delta, int(np.abs(eff_a[:, 0]).max())
+            )
+        vcs_np = np.asarray(vcs, np.int32)
+        if m:
+            np.maximum(
+                self.max_commit_vc, vcs_np.max(axis=0), out=self.max_commit_vc
+            )
         mb = _bucket(m, self.cfg.batch_buckets)
         pad = mb - m
 
@@ -450,6 +575,60 @@ class TypedTable:
         s, j = pos[:, 0], pos[:, 1]
         out = {f: np.asarray(x)[s, j] for f, x in state.items()}
         return out, np.asarray(fresh)[s, j]
+
+    def _pallas_counter_ok(self) -> bool:
+        return (
+            getattr(self.cfg, "use_pallas", False)
+            and self.ty.name == "counter_pn"
+            and self.max_abs_delta
+            <= (2**31 - 1) // max(self.cfg.ops_per_key, 1)
+        )
+
+    def read_resolved_raw(self, shards, rows, read_vcs):
+        """One-launch serving read; returns DEVICE arrays still in routed
+        [P, M'] layout plus the (shard, slot) positions — callers that
+        pipeline batches fetch/unroute later (``copy_to_host_async``).
+
+        Output: (resolved fields or full state [P, M', ...], fresh
+        [P, M'], complete [P, M'], pos [M, 2]).
+        """
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        read_vcs = np.asarray(read_vcs, np.int32)
+        row_mat, pos = self._route(shards, rows)
+        p, mm = row_mat.shape
+        row_gather = np.minimum(row_mat, self.n_rows - 1)
+        vc_mat = np.zeros((p, mm, read_vcs.shape[-1]), np.int32)
+        vc_mat[pos[:, 0], pos[:, 1]] = read_vcs
+        if (read_vcs >= self.max_commit_vc).all():
+            # every row is provably fresh: skip the versioned fold
+            resolved, fresh = self._latest_resolved_fn(
+                self.head, self.head_vc, row_gather, vc_mat
+            )
+            return resolved, fresh, fresh, pos
+        n_ops_mat = self.n_ops[np.arange(p)[:, None], row_gather]
+        n_ops_mat = np.where(row_mat < self.n_rows, n_ops_mat, 0)
+        fn = self._read_resolved_fn(self._pallas_counter_ok())
+        resolved, fresh, complete = fn(
+            self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            row_gather, n_ops_mat, vc_mat,
+        )
+        return resolved, fresh, complete, pos
+
+    def read_resolved(self, shards, rows, read_vcs):
+        """Serving read with device value resolution, one launch, flat
+        output.  Returns (resolved fields [M, ...], fresh [M], complete
+        [M]).  For types without ``resolve_spec`` the fields are the full
+        materialized state.  Incomplete rows (read VC below retained device
+        coverage) need the caller's log-replay fallback, as with
+        :meth:`read`."""
+        resolved, fresh, complete, pos = self.read_resolved_raw(
+            shards, rows, read_vcs
+        )
+        s, j = pos[:, 0], pos[:, 1]
+        out = {f: np.asarray(x)[s, j] for f, x in resolved.items()}
+        return out, np.asarray(fresh)[s, j], np.asarray(complete)[s, j]
 
     def read(self, shards, rows, read_vcs) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]:
         """Materialize a flat batch of keys at per-key read VCs.

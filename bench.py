@@ -1,25 +1,41 @@
 #!/usr/bin/env python
 """North-star benchmark: snapshot-read throughput on a 1M-key OR-set.
 
-The BASELINE.json workload: ``antidote_crdt_set_aw`` with Zipfian access,
-batched snapshot reads at the current VC through the device materializer
-(per-key op-ring fold + VC dominance filtering), vs a sequential host
-materializer that re-implements the reference's per-key walk
-(clocksi_materializer:materialize_intern + apply_operations,
-/root/reference/src/clocksi_materializer.erl:111-197) in plain Python with
-dict vector clocks — the closest stand-in for the BEAM fold this machine
-can run (`vs_baseline` is the speedup over it).
+BASELINE.json workload: ``antidote_crdt_set_aw``, Zipfian access, batched
+snapshot reads vs a sequential host materializer re-implementing the
+reference's per-key walk (clocksi_materializer:materialize_intern +
+apply_operations, /root/reference/src/clocksi_materializer.erl:111-197) in
+plain Python with dict vector clocks.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "reads/s", "vs_baseline": N, ...}
+Two numbers are reported (r1 VERDICT items 1-2):
 
-Usage: python bench.py [--smoke]
+* ``value`` — the SERVING PATH: reads through
+  ``TypedTable.read_resolved`` (host shard routing + freshness check +
+  snapshot-version select + versioned ring fold + device value
+  resolution), with one batch in five at a historical VC so the
+  materializer fold (``fold_batch``) is inside the timed loop.  Pipelined
+  batches model basho_bench's concurrent workers.
+* ``device_kernel_reads_per_s`` — the device-only kernel loop (head gather
+  + OR-set presence resolution), isolating what the chip does from what
+  the ~50-100 ms dev-tunnel RTT costs; on a real PCIe host the serving
+  number approaches it.
+
+Process layout (fail-soft, r1 VERDICT item 1): the parent runs the real
+bench in a CHILD process with a hard wall-clock timeout (TPU backend init
+has been observed to hang >8 min in this environment), retries once, then
+falls back to JAX_PLATFORMS=cpu with a smaller key count.  The parent
+ALWAYS prints exactly one JSON line on stdout and exits 0; failures are
+reported in an ``"error"`` field, never as a traceback + rc=1.
+
+Usage: python bench.py [--smoke] [--keys N]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -30,70 +46,134 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def zipf_sampler(n_keys: int, s: float, rng):
-    w = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** s
-    cdf = np.cumsum(w / w.sum())
-
-    def sample(size):
-        return np.searchsorted(cdf, rng.random(size)).astype(np.int64)
-
-    return sample
+METRIC = "serving_read_throughput_set_aw_zipf"
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--smoke", action="store_true", help="small, fast run")
-    ap.add_argument("--keys", type=int, default=None)
-    args = ap.parse_args()
+# ---------------------------------------------------------------------------
+# parent: fail-soft orchestration
+# ---------------------------------------------------------------------------
+def _run_attempt(extra_args, env_over, timeout_s):
+    """Run the child; return (parsed_json | None, note)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + extra_args
+    env = dict(os.environ)
+    env.update(env_over)
+    log(f"bench[parent]: {' '.join(extra_args) or '(default)'} "
+        f"env={env_over} timeout={timeout_s}s")
+    try:
+        res = subprocess.run(
+            cmd, env=env, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=sys.stderr,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s"
+    out = res.stdout.decode(errors="replace")
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"child rc={res.returncode}, no JSON line"
 
+
+def parent(args):
+    smoke = ["--smoke"] if args.smoke else []
+    t_tpu = int(os.environ.get("ANTIDOTE_BENCH_TPU_TIMEOUT", "1200"))
+    t_retry = int(os.environ.get("ANTIDOTE_BENCH_RETRY_TIMEOUT", "420"))
+    t_cpu = int(os.environ.get("ANTIDOTE_BENCH_CPU_TIMEOUT", "900"))
+    if args.smoke:
+        t_tpu, t_retry, t_cpu = min(t_tpu, 600), min(t_retry, 300), min(t_cpu, 600)
+    keyarg = ["--keys", str(args.keys)] if args.keys else []
+    cpu_keys = ["--keys", str(args.keys or (20_000 if args.smoke else 200_000))]
+    plan = [
+        (smoke + keyarg, {}, t_tpu),
+        (smoke + keyarg, {}, t_retry),
+        (smoke + cpu_keys, {"JAX_PLATFORMS": "cpu"}, t_cpu),
+    ]
+    notes = []
+    for i, (extra, env_over, timeout_s) in enumerate(plan):
+        got, note = _run_attempt(extra, env_over, timeout_s)
+        if got is not None:
+            if notes:
+                got["error"] = "; ".join(notes) + " (recovered)"
+            print(json.dumps(got))
+            return 0
+        notes.append(f"attempt{i + 1}[{env_over.get('JAX_PLATFORMS', 'default')}]: {note}")
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "reads/s", "vs_baseline": 0.0,
+        "error": "; ".join(notes),
+    }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# child: the measured workload
+# ---------------------------------------------------------------------------
+def child(args):
     import jax
+
+    # The axon site wrapper initializes the TPU backend on default-backend
+    # resolution EVEN under JAX_PLATFORMS=cpu (its anti-silent-fallback
+    # design); jax.config.update is honored, so mirror the env var into
+    # the config before any backend resolution (same trick as
+    # tests/conftest.py).
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and "," not in want:
+        jax.config.update("jax_platforms", want)
 
     from antidote_tpu.config import AntidoteConfig
     from antidote_tpu.crdt import get_type
     from antidote_tpu.store import TypedTable
 
     n_keys = args.keys or (20_000 if args.smoke else 1_000_000)
+    n_shards = 8
     ops_per_key = 3
-    read_batch = 4096
-    timed_batches = 100 if args.smoke else 400
     pop_batch = 16384
+    serve_batch = 16384 if n_keys >= 100_000 else 4096
+    device_batch = 4096
+    serve_batches = 20 if args.smoke else 60
+    device_batches = 100 if args.smoke else 400
     baseline_reads = 500 if args.smoke else 2000
+    hist_every = 5  # 1 in 5 serving batches reads at a historical VC
 
+    platform = jax.default_backend()
     cfg = AntidoteConfig(
-        n_shards=1,
+        n_shards=n_shards,
         max_dcs=4,
         ops_per_key=16,
         snap_versions=2,
         set_slots=16,
-        keys_per_table=n_keys,
-        batch_buckets=(read_batch, pop_batch),
+        keys_per_table=(n_keys + n_shards - 1) // n_shards,
+        batch_buckets=(4096, 16384),
+        use_pallas=platform in ("tpu", "axon"),
     )
     ty = get_type("set_aw")
     rng = np.random.default_rng(7)
     d = cfg.max_dcs
     bw = ty.eff_b_width(cfg)
+    log(f"bench: platform={platform} n_keys={n_keys} shards={n_shards}")
+    n_rows = (n_keys + n_shards - 1) // n_shards
+    table = TypedTable(ty, cfg, n_rows=n_rows, n_shards=n_shards)
+    for s in range(n_shards):
+        table.used_rows[s] = (n_keys - s + n_shards - 1) // n_shards
 
-    log(f"bench: platform={jax.devices()[0].platform} n_keys={n_keys}")
-    table = TypedTable(ty, cfg, n_rows=n_keys, n_shards=1)
-    table.used_rows[0] = n_keys  # rows pre-bound: row == key
+    def srows(keys):
+        return keys % n_shards, keys // n_shards
 
     # ---- populate: ops_per_key adds per key (+ removes on 10% of keys) ----
     keys = np.repeat(np.arange(n_keys, dtype=np.int64), ops_per_key)
     rng.shuffle(keys)
     elems = rng.integers(1, 1 << 62, size=keys.shape[0], dtype=np.int64)
     total = keys.shape[0]
-    # per-op commit VC: lane 0 strictly increasing in commit order
-    lane0 = np.arange(1, total + 1, dtype=np.int32)
-    # remember the add VC of the first-seen add per key (for removes)
-    first_add_vc = np.zeros(n_keys, np.int32)
-    first_add_elem = np.zeros(n_keys, np.int64)
-    seen_first = np.zeros(n_keys, bool)
-    firsts = ~seen_first[keys]
-    # compute first occurrence of each key in the shuffled stream
+    lane0 = np.arange(1, total + 1, dtype=np.int32)  # commit order on lane 0
+    # first-seen add per key (removes observe it)
     first_idx = np.full(n_keys, -1, np.int64)
     rev = np.arange(total - 1, -1, -1)
-    first_idx[keys[rev]] = rev  # later writes win => first occurrence
+    first_idx[keys[rev]] = rev
     valid_first = first_idx >= 0
+    first_add_vc = np.zeros(n_keys, np.int32)
+    first_add_elem = np.zeros(n_keys, np.int64)
     first_add_vc[valid_first] = lane0[first_idx[valid_first]]
     first_add_elem[valid_first] = elems[first_idx[valid_first]]
 
@@ -104,16 +184,12 @@ def main():
         m = hi - lo
         vcs = np.zeros((m, d), np.int32)
         vcs[:, 0] = lane0[lo:hi]
-        table.append(
-            np.zeros(m, np.int64),
-            keys[lo:hi],
-            elems[lo:hi, None],
-            zeros_b[:m],
-            vcs,
-            np.zeros(m, np.int32),
-        )
+        ss, rr = srows(keys[lo:hi])
+        table.append(ss, rr, elems[lo:hi, None], zeros_b[:m], vcs,
+                     np.zeros(m, np.int32))
+        if (lo // pop_batch) % 50 == 0:
+            log(f"populate: {hi}/{total}")
     clock0 = total
-    # removes: 10% of keys lose their first-added element
     rm_keys = rng.choice(n_keys, size=n_keys // 10, replace=False).astype(np.int64)
     rm_keys = rm_keys[valid_first[rm_keys]]
     nrm = rm_keys.shape[0]
@@ -122,91 +198,132 @@ def main():
         m = hi - lo
         kk = rm_keys[lo:hi]
         eff_b = np.zeros((m, bw), np.int32)
-        eff_b[:, 0] = 1  # remove
-        eff_b[:, 1] = first_add_vc[kk]  # observed add dot on lane 0
+        eff_b[:, 0] = 1
+        eff_b[:, 1] = first_add_vc[kk]
         vcs = np.zeros((m, d), np.int32)
         vcs[:, 0] = clock0 + 1 + lo + np.arange(m, dtype=np.int32)
-        table.append(
-            np.zeros(m, np.int64),
-            kk,
-            first_add_elem[kk, None],
-            eff_b,
-            vcs,
-            np.zeros(m, np.int32),
-        )
+        ss, rr = srows(kk)
+        table.append(ss, rr, first_add_elem[kk, None], eff_b, vcs,
+                     np.zeros(m, np.int32))
+    final_t = clock0 + nrm
     final_clock = np.zeros(d, np.int32)
-    final_clock[0] = clock0 + nrm
+    final_clock[0] = final_t
+    mid_t = int(total * 0.6)  # historical point: 60% through the add stream
+    mid_clock = np.zeros(d, np.int32)
+    mid_clock[0] = mid_t
     log(f"populate: {total + nrm} ops in {time.perf_counter() - t0:.1f}s")
 
-    # ---- measured: Zipfian batched snapshot reads ----
-    # The timed loop is device-resident: Zipfian key sampling (inverse CDF),
-    # head-state gather, and OR-set presence resolution all run on device;
-    # the per-batch host↔device traffic is only the returned values.  (The
-    # dev tunnel to the chip has ~50 ms fixed host→device latency, which
-    # would otherwise measure the tunnel, not the materializer.)
-    import jax.numpy as jnp
-
+    # ---- host Zipfian sampler (the serving path routes on host) ----
     w = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** 1.0
-    cdf = jnp.asarray(np.cumsum(w / w.sum()), jnp.float32)
+    cdf = np.cumsum(w / w.sum())
 
-    @jax.jit
-    def read_step(prng, cdf, head_elems, head_addvc, head_rmvc):
-        prng, sub = jax.random.split(prng)
-        u = jax.random.uniform(sub, (read_batch,))
-        kk = jnp.searchsorted(cdf, u)
-        elems = head_elems[0, kk]                      # [B, E]
-        present = jnp.any(head_addvc[0, kk] > head_rmvc[0, kk], axis=-1)
-        present = present & (elems != 0)
-        # compact the value view: up to 4 present elements + true count
-        # (keys needing more re-fetch the full row; none in this workload)
-        order = jnp.argsort(~present, axis=-1, stable=True)[:, :4]
-        top = jnp.take_along_axis(jnp.where(present, elems, 0), order, axis=-1)
-        out = jnp.concatenate(
-            [top, present.sum(-1, keepdims=True).astype(jnp.int64)], axis=-1
-        )
-        return prng, out
+    def sample(size):
+        return np.searchsorted(cdf, rng.random(size)).astype(np.int64)
 
-    # reads at the current VC are exact via the head (verify once)
-    hvc = np.asarray(table.head_vc[0, :64])
-    assert (hvc <= final_clock).all()
+    # =======================================================================
+    # measured 1: SERVING PATH — TypedTable.read_resolved end to end
+    # =======================================================================
+    vc_final_b = np.broadcast_to(final_clock, (serve_batch, d))
+    vc_mid_b = np.broadcast_to(mid_clock, (serve_batch, d))
 
-    prng = jax.random.PRNGKey(3)
-    he, ha, hr = table.head["elems"], table.head["addvc"], table.head["rmvc"]
-    for _ in range(3):  # warmup/compile
-        prng, ev = read_step(prng, cdf, he, ha, hr)
-        np.asarray(ev)
-    # single-request round-trip latency (includes the dev tunnel's ~100 ms
-    # fixed RTT; a real PCIe host would see microseconds here)
+    def serve_one(i):
+        kk = sample(serve_batch)
+        ss, rr = srows(kk)
+        vcs = vc_mid_b if (i % hist_every == hist_every - 1) else vc_final_b
+        return table.read_resolved_raw(ss, rr, vcs)
+
+    # warmup/compile both VC variants
+    for i in (0, hist_every - 1):
+        resolved, fresh, complete, pos = serve_one(i)
+        np.asarray(resolved["top"])
+    # unpipelined per-batch latency
     lat = []
-    for _ in range(5):
+    stale_hist = []
+    for i in range(6):
         tb = time.perf_counter()
-        prng, ev = read_step(prng, cdf, he, ha, hr)
-        np.asarray(ev)
+        resolved, fresh, complete, pos = serve_one(i)
+        np.asarray(resolved["top"]), np.asarray(resolved["count"])
         lat.append(time.perf_counter() - tb)
+        if i % hist_every == hist_every - 1:
+            f = np.asarray(fresh)[pos[:, 0], pos[:, 1]]
+            stale_hist.append(1.0 - f.mean())
     lat_ms = np.asarray(lat) * 1e3
-    # throughput: pipelined async value fetches — the moral equivalent of
-    # basho_bench's 100 concurrent workers keeping the server busy
+    # pipelined throughput (≈ basho_bench's concurrent workers)
     import collections
 
     q = collections.deque()
+    depth = 8
+    t0 = time.perf_counter()
+    for i in range(serve_batches):
+        resolved, fresh, complete, pos = serve_one(i)
+        for x in resolved.values():
+            x.copy_to_host_async()
+        q.append(resolved)
+        if len(q) > depth:
+            old = q.popleft()
+            np.asarray(old["top"])
+    while q:
+        np.asarray(q.popleft()["top"])
+    serve_elapsed = time.perf_counter() - t0
+    serving_rps = serve_batches * serve_batch / serve_elapsed
+    log(f"serving path: {serving_rps:,.0f} reads/s "
+        f"(batch={serve_batch}, hist 1/{hist_every}, "
+        f"stale_frac_hist={np.mean(stale_hist):.2f}, "
+        f"batch p50={np.percentile(lat_ms, 50):.1f}ms)")
+
+    # =======================================================================
+    # measured 2: DEVICE KERNEL — head gather + presence resolve on device
+    # =======================================================================
+    import jax.numpy as jnp
+
+    cdf_dev = jnp.asarray(cdf, jnp.float32)
+    he, ha, hr, ho = (table.head["elems"], table.head["addvc"],
+                      table.head["rmvc"], table.head["ovf"])
+
+    @jax.jit
+    def device_step(prng, cdf_d, elems_h, addvc_h, rmvc_h, ovf_h):
+        prng, sub = jax.random.split(prng)
+        u = jax.random.uniform(sub, (device_batch,))
+        kk = jnp.searchsorted(cdf_d, u)
+        s, r = kk % n_shards, kk // n_shards
+        state = {
+            "elems": elems_h[s, r], "addvc": addvc_h[s, r],
+            "rmvc": rmvc_h[s, r], "ovf": ovf_h[s, r],
+        }
+        out = ty.resolve(cfg, state)
+        return prng, jnp.concatenate(
+            [out["top"], out["count"][:, None].astype(jnp.int64)], axis=-1
+        )
+
+    prng = jax.random.PRNGKey(3)
+    for _ in range(3):
+        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
+        np.asarray(ev)
+    rtt = []
+    for _ in range(5):
+        tb = time.perf_counter()
+        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
+        np.asarray(ev)
+        rtt.append(time.perf_counter() - tb)
+    rtt_ms = np.asarray(rtt) * 1e3
+    q = collections.deque()
     depth = 32
     t0 = time.perf_counter()
-    for _ in range(timed_batches):
-        prng, ev = read_step(prng, cdf, he, ha, hr)
+    for _ in range(device_batches):
+        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
         ev.copy_to_host_async()
         q.append(ev)
         if len(q) > depth:
             np.asarray(q.popleft())
     while q:
         np.asarray(q.popleft())
-    elapsed = time.perf_counter() - t0
-    tpu_rps = timed_batches * read_batch / elapsed
-    log(f"device: {tpu_rps:,.0f} reads/s  rtt p50={np.percentile(lat_ms, 50):.2f}ms")
+    device_rps = device_batches * device_batch / (time.perf_counter() - t0)
+    log(f"device kernel: {device_rps:,.0f} reads/s  "
+        f"rtt p50={np.percentile(rtt_ms, 50):.2f}ms")
 
-    # correctness spot-check: head values match the host materializer
-    sample = zipf_sampler(n_keys, 1.0, rng)
-
-    # ---- baseline: sequential host materializer (reference-style walk) ----
+    # =======================================================================
+    # baseline: sequential host materializer (reference-style walk)
+    # =======================================================================
     ops_by_key = {}
     for i in range(total):
         ops_by_key.setdefault(int(keys[i]), []).append(
@@ -218,9 +335,8 @@ def main():
             ({"dc0": int(clock0 + 1 + j)}, "rm",
              (int(first_add_elem[k]), {"dc0": int(first_add_vc[k])}))
         )
-    read_vc_dict = {"dc0": int(final_clock[0])}
 
-    def baseline_read(k):
+    def baseline_read(k, read_vc_dict):
         # the reference fold: per-op dict-VC dominance check, then apply
         adds, rms = {}, {}
         for op_vc, kind, payload in ops_by_key.get(k, ()):
@@ -241,41 +357,68 @@ def main():
         return [e for e, avc in adds.items()
                 if any(t > rms.get(e, {}).get(dc, 0) for dc, t in avc.items())]
 
+    final_vc_dict = {"dc0": final_t}
+    mid_vc_dict = {"dc0": mid_t}
     bkeys = sample(baseline_reads)
     t0 = time.perf_counter()
     for k in bkeys:
-        baseline_read(int(k))
+        baseline_read(int(k), final_vc_dict)
     base_rps = baseline_reads / (time.perf_counter() - t0)
     log(f"baseline(host python per-key fold): {base_rps:,.0f} reads/s")
 
-    # correctness spot-check: device head values == host materializer values
-    chk = bkeys[:32].astype(np.int64)
-    state, fresh = table.read_latest(
-        np.zeros(32, np.int64), chk, np.broadcast_to(final_clock, (32, d))
-    )
-    assert fresh.all()
-    for i, k in enumerate(chk):
-        pres = (state["addvc"][i] > state["rmvc"][i]).any(-1) & (
-            state["elems"][i] != 0
+    # ---- correctness spot-check: serving values == host materializer ----
+    for at_clock, at_dict, tag in (
+        (final_clock, final_vc_dict, "final"),
+        (mid_clock, mid_vc_dict, "historical"),
+    ):
+        chk = bkeys[:32].astype(np.int64)
+        ss, rr = srows(chk)
+        out, fresh, complete = table.read_resolved(
+            ss, rr, np.broadcast_to(at_clock, (32, d))
         )
-        dev = sorted(int(e) for e, p in zip(state["elems"][i], pres) if p)
-        ref = sorted(baseline_read(int(k)))
-        assert dev == ref, (int(k), dev, ref)
-    log("spot-check: device values match host materializer on 32 keys")
+        assert complete.all()
+        for i, k in enumerate(chk):
+            ref = sorted(baseline_read(int(k), at_dict))
+            cnt = int(out["count"][i])
+            dev = sorted(int(e) for e in out["top"][i] if e != 0)
+            assert cnt == len(ref), (tag, int(k), cnt, len(ref))
+            if cnt <= ty.resolve_top:
+                assert dev == ref, (tag, int(k), dev, ref)
+    log("spot-check: serving values match host materializer "
+        "(fresh + historical) on 64 keys")
 
     print(json.dumps({
-        "metric": "snapshot_read_throughput_set_aw_zipf",
-        "value": round(tpu_rps, 1),
+        "metric": METRIC,
+        "value": round(serving_rps, 1),
         "unit": "reads/s",
-        "vs_baseline": round(tpu_rps / base_rps, 2),
-        "n_keys": n_keys,
-        "read_batch": read_batch,
-        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
-        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "vs_baseline": round(serving_rps / base_rps, 2),
+        "device_kernel_reads_per_s": round(device_rps, 1),
+        "device_vs_baseline": round(device_rps / base_rps, 2),
         "baseline_reads_per_s": round(base_rps, 1),
         "baseline_kind": "python_host_per_key_fold",
-        "platform": jax.devices()[0].platform,
+        "n_keys": n_keys,
+        "serve_batch": serve_batch,
+        "historical_batch_every": hist_every,
+        "stale_fraction_historical": round(float(np.mean(stale_hist)), 3),
+        "serve_batch_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "serve_batch_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "device_rtt_p50_ms": round(float(np.percentile(rtt_ms, 50)), 2),
+        "use_pallas": bool(cfg.use_pallas),
+        "platform": platform,
     }))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small, fast run")
+    ap.add_argument("--keys", type=int, default=None)
+    ap.add_argument("--child", action="store_true",
+                    help="internal: run the measured workload in-process")
+    args = ap.parse_args()
+    if args.child:
+        sys.exit(child(args))
+    sys.exit(parent(args))
 
 
 if __name__ == "__main__":

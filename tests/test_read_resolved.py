@@ -1,0 +1,233 @@
+"""The fused serving read (TypedTable.read_resolved / KVStore.read_resolved)
+and the in-path Pallas kernel dispatch.
+
+Covers the read path of SURVEY §3.3 as ONE device launch: freshness check,
+snapshot-version select, versioned ring fold, device value resolution — and
+checks the Pallas variants (cfg.use_pallas) against the plain-XLA fold,
+which remains the semantics oracle (the r1 VERDICT asked for production
+call sites + dispatch tests).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt import get_type
+from antidote_tpu.store import TypedTable
+from antidote_tpu.store.kv import KVStore
+
+
+def _mk_cfg(**kw):
+    base = dict(
+        n_shards=2, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=8, mv_slots=4, rga_slots=16, keys_per_table=16,
+        batch_buckets=(16, 64),
+    )
+    base.update(kw)
+    return AntidoteConfig(**base)
+
+
+def _populate_set(table, n_keys, d):
+    """3 adds per key on lane 0, then remove the first add on even keys."""
+    clock = 0
+    first = {}
+    for r in range(n_keys):
+        for j in range(3):
+            clock += 1
+            vc = np.zeros(d, np.int32)
+            vc[0] = clock
+            elem = 100 * (r + 1) + j
+            first.setdefault(r, (elem, clock))
+            table.append(
+                np.asarray([r % table.n_shards]), np.asarray([r]),
+                np.asarray([[elem]], np.int64),
+                np.zeros((1, 1 + d), np.int32), vc[None, :],
+                np.asarray([0], np.int32),
+            )
+    mid = clock  # historical read point: before any removes
+    for r in range(0, n_keys, 2):
+        elem, add_t = first[r]
+        clock += 1
+        vc = np.zeros(d, np.int32)
+        vc[0] = clock
+        b = np.zeros((1, 1 + d), np.int32)
+        b[0, 0] = 1
+        b[0, 1] = add_t
+        table.append(
+            np.asarray([r % table.n_shards]), np.asarray([r]),
+            np.asarray([[elem]], np.int64), b, vc[None, :],
+            np.asarray([0], np.int32),
+        )
+    return mid, clock
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_set_aw_read_resolved_fresh_and_historical(use_pallas):
+    cfg = _mk_cfg(use_pallas=use_pallas)
+    ty = get_type("set_aw")
+    d = cfg.max_dcs
+    table = TypedTable(ty, cfg, n_rows=16, n_shards=2)
+    n_keys = 10
+    for s in range(2):
+        table.used_rows[s] = n_keys
+    mid, final = _populate_set(table, n_keys, d)
+
+    rows = np.arange(n_keys, dtype=np.int64)
+    shards = rows % 2
+    vc_final = np.zeros((n_keys, d), np.int32)
+    vc_final[:, 0] = final
+    out, fresh, complete = table.read_resolved(shards, rows, vc_final)
+    assert fresh.all() and complete.all()
+    for r in range(n_keys):
+        want = {100 * (r + 1) + j for j in range(3)}
+        if r % 2 == 0:
+            want.discard(100 * (r + 1))  # first add removed
+        got = {int(x) for x in out["top"][r] if x != 0}
+        assert got == want, r
+        assert int(out["count"][r]) == len(want)
+
+    # historical read: before the removes — the fold path (not the head)
+    vc_mid = np.zeros((n_keys, d), np.int32)
+    vc_mid[:, 0] = mid
+    out2, fresh2, complete2 = table.read_resolved(shards, rows, vc_mid)
+    assert complete2.all()
+    assert not fresh2[::2].any()  # removed keys' heads are newer than mid
+    for r in range(n_keys):
+        want = {100 * (r + 1) + j for j in range(3)}  # removes not visible
+        got = {int(x) for x in out2["top"][r] if x != 0}
+        assert got == want, r
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_counter_read_resolved_matches_oracle(use_pallas):
+    cfg = _mk_cfg(use_pallas=use_pallas)
+    ty = get_type("counter_pn")
+    d = cfg.max_dcs
+    table = TypedTable(ty, cfg, n_rows=8, n_shards=1)
+    table.used_rows[0] = 4
+    rng = np.random.default_rng(0)
+    clock = 0
+    totals = np.zeros(4, np.int64)
+    mid_totals = None
+    mid = None
+    for i in range(20):
+        r = int(rng.integers(0, 4))
+        delta = int(rng.integers(-50, 50))
+        clock += 1
+        vc = np.zeros(d, np.int32)
+        vc[0] = clock
+        table.append(
+            np.asarray([0]), np.asarray([r]),
+            np.asarray([[delta]], np.int64),
+            np.zeros((1, 1), np.int32), vc[None, :],
+            np.asarray([0], np.int32),
+        )
+        totals[r] += delta
+        if i == 9:
+            mid, mid_totals = clock, totals.copy()
+    rows = np.arange(4, dtype=np.int64)
+    shards = np.zeros(4, np.int64)
+    for at, want in ((clock, totals), (mid, mid_totals)):
+        vcs = np.zeros((4, d), np.int32)
+        vcs[:, 0] = at
+        out, _, complete = table.read_resolved(shards, rows, vcs)
+        assert complete.all()
+        assert (out["value"] == want).all(), (at, out["value"], want)
+    if use_pallas:
+        assert table._pallas_counter_ok()
+
+
+def test_counter_pallas_falls_back_on_huge_deltas():
+    cfg = _mk_cfg(use_pallas=True)
+    ty = get_type("counter_pn")
+    table = TypedTable(ty, cfg, n_rows=8, n_shards=1)
+    table.used_rows[0] = 1
+    vc = np.zeros((1, cfg.max_dcs), np.int32)
+    vc[0, 0] = 1
+    big = 2**40
+    table.append(
+        np.asarray([0]), np.asarray([0]), np.asarray([[big]], np.int64),
+        np.zeros((1, 1), np.int32), vc, np.asarray([0], np.int32),
+    )
+    assert not table._pallas_counter_ok()  # i32 kernel would overflow
+    out, _, _ = table.read_resolved(
+        np.asarray([0]), np.asarray([0]), vc
+    )
+    assert int(out["value"][0]) == big
+
+
+def test_kvstore_read_resolved_matches_read_values():
+    cfg = _mk_cfg()
+    store = KVStore(cfg)
+    from antidote_tpu.store.kv import Effect
+
+    clock = 0
+    d = cfg.max_dcs
+    objs = [(f"k{i}", "set_aw", "b") for i in range(6)]
+    for i, (k, tname, bucket) in enumerate(objs):
+        for j in range(2):
+            ty = get_type(tname)
+            eff = ty.downstream(("add", f"v{i}{j}"), None, store.blobs, cfg)[0]
+            clock += 1
+            vc = np.zeros(d, np.int32)
+            vc[0] = clock
+            store.apply_effects(
+                [Effect(k, tname, bucket, eff[0], eff[1], eff[2])], [vc], [0]
+            )
+    at = store.dc_max_vc()
+    values = store.read_values(objs, at)
+    resolved = store.read_resolved(objs, at)
+    for i, (k, tname, bucket) in enumerate(objs):
+        got = sorted(
+            store.blobs.resolve(int(h)) for h in resolved[i]["top"] if h != 0
+        )
+        assert got == sorted(values[i])
+        assert int(resolved[i]["count"]) == len(values[i])
+    # unseen key → bottom value
+    bottom = store.read_resolved([("nope", "set_aw", "b")], at)[0]
+    assert int(bottom["count"]) == 0
+
+
+def test_stable_min_of_pallas_path():
+    from antidote_tpu.store.kv import stable_min_of
+
+    cfg = _mk_cfg(use_pallas=True)
+    store = KVStore(cfg)
+    store.applied_vc[:] = np.asarray([[3, 1, 9], [2, 5, 4]], np.int32)
+    assert (store.stable_vc() == np.asarray([2, 1, 4])).all()
+    # the large-matrix path (multi-node aggregation) takes the kernel
+    big = np.random.default_rng(1).integers(0, 1000, size=(4096, 3)).astype(np.int32)
+    assert (stable_min_of(big, use_pallas=True) == big.min(axis=0)).all()
+
+
+def test_handoff_preserves_serving_gates():
+    """import_shard / reshard must carry max_abs_delta / max_commit_vc so
+    the Pallas counter dispatch and the provably-fresh fast path stay
+    sound after a shard moves (r2 review finding)."""
+    from antidote_tpu.store import handoff
+    from antidote_tpu.store.kv import Effect
+
+    cfg = _mk_cfg(use_pallas=True)
+    src = KVStore(cfg)
+    ty = get_type("counter_pn")
+    eff = ty.downstream(("increment", 2**40), None, src.blobs, cfg)[0]
+    vc = np.zeros(cfg.max_dcs, np.int32)
+    vc[0] = 7
+    src.apply_effects([Effect("k", "counter_pn", "b", eff[0], eff[1])], [vc], [0])
+    t_src = src.tables["counter_pn"]
+    assert t_src.max_abs_delta >= 2**40
+    shard = src.locate("k", "counter_pn", "b")[1]
+
+    dst = KVStore(cfg)
+    handoff.import_shard(dst, handoff.export_shard(src, shard, include_log=False))
+    t_dst = dst.tables["counter_pn"]
+    assert t_dst.max_abs_delta >= 2**40
+    assert not t_dst._pallas_counter_ok()
+    assert (t_dst.max_commit_vc == t_src.max_commit_vc).all()
+
+    re = handoff.reshard(src, dataclasses.replace(cfg, n_shards=4))
+    t_re = re.tables["counter_pn"]
+    assert t_re.max_abs_delta >= 2**40
+    assert (t_re.max_commit_vc == t_src.max_commit_vc).all()
