@@ -27,7 +27,16 @@ falls back to JAX_PLATFORMS=cpu with a smaller key count.  The parent
 ALWAYS prints exactly one JSON line on stdout and exits 0; failures are
 reported in an ``"error"`` field, never as a traceback + rc=1.
 
-Usage: python bench.py [--smoke] [--keys N]
+r2 VERDICT item 1 additions: every phase logs start/end + elapsed on
+stderr so a timeout localizes itself; the child arms
+``faulthandler.dump_traceback_later`` so a hang prints the stuck Python
+stack; the Pallas in-path dispatch (the only delta between the CPU run
+that worked and the TPU run that hung) is bisected — the first TPU
+attempt runs ``--pallas off`` (pure XLA, the configuration proven on
+CPU), and Pallas is then tried as a separate UPGRADE attempt whose
+failure cannot lose the landed number.
+
+Usage: python bench.py [--smoke] [--keys N] [--pallas auto|on|off]
 """
 
 from __future__ import annotations
@@ -41,9 +50,11 @@ import time
 
 import numpy as np
 
+_T0 = time.time()
+
 
 def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+    print(f"[bench {time.time() - _T0:8.1f}s]", *a, file=sys.stderr, flush=True)
 
 
 METRIC = "serving_read_throughput_set_aw_zipf"
@@ -57,7 +68,7 @@ def _run_attempt(extra_args, env_over, timeout_s):
     cmd = [sys.executable, os.path.abspath(__file__), "--child"] + extra_args
     env = dict(os.environ)
     env.update(env_over)
-    log(f"bench[parent]: {' '.join(extra_args) or '(default)'} "
+    log(f"parent: attempt {' '.join(extra_args) or '(default)'} "
         f"env={env_over} timeout={timeout_s}s")
     try:
         res = subprocess.run(
@@ -82,28 +93,68 @@ def parent(args):
     t_tpu = int(os.environ.get("ANTIDOTE_BENCH_TPU_TIMEOUT", "1200"))
     t_retry = int(os.environ.get("ANTIDOTE_BENCH_RETRY_TIMEOUT", "420"))
     t_cpu = int(os.environ.get("ANTIDOTE_BENCH_CPU_TIMEOUT", "900"))
+    t_pallas = int(os.environ.get("ANTIDOTE_BENCH_PALLAS_TIMEOUT", "600"))
     if args.smoke:
         t_tpu, t_retry, t_cpu = min(t_tpu, 600), min(t_retry, 300), min(t_cpu, 600)
+        t_pallas = min(t_pallas, 300)
     keyarg = ["--keys", str(args.keys)] if args.keys else []
     cpu_keys = ["--keys", str(args.keys or (20_000 if args.smoke else 200_000))]
+    # Bisect plan (r2 VERDICT item 1): the TPU attempt that hung was the
+    # only configuration running the Pallas in-path dispatch, so by
+    # default the landing attempts force --pallas off and Pallas runs as
+    # an upgrade.  An explicit --pallas on/off is honored verbatim (and
+    # disables the bisect: there is nothing to upgrade to).
+    land_pallas = "off" if args.pallas == "auto" else args.pallas
     plan = [
-        (smoke + keyarg, {}, t_tpu),
-        (smoke + keyarg, {}, t_retry),
-        (smoke + cpu_keys, {"JAX_PLATFORMS": "cpu"}, t_cpu),
+        (smoke + keyarg + ["--pallas", land_pallas], {}, t_tpu),
+        (smoke + keyarg + ["--pallas", land_pallas], {}, t_retry),
+        (smoke + cpu_keys + ["--pallas", land_pallas], {"JAX_PLATFORMS": "cpu"}, t_cpu),
     ]
     notes = []
+    got = None
     for i, (extra, env_over, timeout_s) in enumerate(plan):
+        t_land0 = time.time()
         got, note = _run_attempt(extra, env_over, timeout_s)
+        land_wall = time.time() - t_land0
         if got is not None:
-            if notes:
-                got["error"] = "; ".join(notes) + " (recovered)"
-            print(json.dumps(got))
-            return 0
+            break
         notes.append(f"attempt{i + 1}[{env_over.get('JAX_PLATFORMS', 'default')}]: {note}")
-    print(json.dumps({
-        "metric": METRIC, "value": 0.0, "unit": "reads/s", "vs_baseline": 0.0,
-        "error": "; ".join(notes),
-    }))
+    if got is None:
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "reads/s", "vs_baseline": 0.0,
+            "error": "; ".join(notes),
+        }))
+        return 0
+    # Upgrade attempt: same platform, Pallas dispatch ON.  Only replaces
+    # the landed result if it finishes AND serves faster.  Budget at
+    # least 1.5x the landed run's wall clock + compile margin, so a
+    # healthy-but-slower Pallas run isn't misreported as a hang — but
+    # never push total parent wall clock past the pre-upgrade worst case
+    # (t_tpu + t_retry + t_cpu): an outer harness deadline calibrated to
+    # that envelope must not kill us mid-upgrade and lose the landed
+    # number.
+    total_left = (t_tpu + t_retry + t_cpu) - (time.time() - _T0)
+    if (got.get("platform") in ("tpu", "axon") and args.pallas == "auto"
+            and not args.no_pallas_upgrade):
+        t_pallas = max(t_pallas, int(land_wall * 1.5) + 120)
+        t_pallas = int(min(t_pallas, total_left))
+    if (got.get("platform") in ("tpu", "axon") and args.pallas == "auto"
+            and not args.no_pallas_upgrade and t_pallas >= 180):
+        up, unote = _run_attempt(smoke + keyarg + ["--pallas", "on"], {}, t_pallas)
+        if up is not None and up.get("value", 0) > got.get("value", 0):
+            up["pallas_upgrade"] = (
+                f"+{(up['value'] / max(got['value'], 1) - 1) * 100:.0f}% over XLA path"
+            )
+            got = up
+        elif up is not None:
+            got["pallas_attempt"] = (
+                f"completed but not faster ({up.get('value')} reads/s)"
+            )
+        else:
+            got["pallas_attempt"] = f"failed: {unote}"
+    if notes:
+        got["error"] = "; ".join(notes) + " (recovered)"
+    print(json.dumps(got))
     return 0
 
 
@@ -111,7 +162,30 @@ def parent(args):
 # child: the measured workload
 # ---------------------------------------------------------------------------
 def child(args):
-    import jax
+    import faulthandler
+
+    # a hang now dumps the stuck Python stack every 180 s instead of
+    # burning the whole parent timeout silently (r2 VERDICT weak #1)
+    faulthandler.dump_traceback_later(180, repeat=True, file=sys.stderr)
+
+    phases = {}
+
+    class phase:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            log(f"phase {self.name}: start")
+            self.t = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            dt = time.perf_counter() - self.t
+            phases[self.name] = round(dt, 2)
+            log(f"phase {self.name}: done in {dt:.1f}s")
+
+    with phase("import_jax"):
+        import jax
 
     # The axon site wrapper initializes the TPU backend on default-backend
     # resolution EVEN under JAX_PLATFORMS=cpu (its anti-silent-fallback
@@ -137,7 +211,13 @@ def child(args):
     baseline_reads = 500 if args.smoke else 2000
     hist_every = 5  # 1 in 5 serving batches reads at a historical VC
 
-    platform = jax.default_backend()
+    with phase("backend_init"):
+        platform = jax.default_backend()
+        n_dev = len(jax.devices())
+    if args.pallas == "auto":
+        use_pallas = platform in ("tpu", "axon")
+    else:
+        use_pallas = args.pallas == "on"
     cfg = AntidoteConfig(
         n_shards=n_shards,
         max_dcs=4,
@@ -146,13 +226,14 @@ def child(args):
         set_slots=16,
         keys_per_table=(n_keys + n_shards - 1) // n_shards,
         batch_buckets=(4096, 16384),
-        use_pallas=platform in ("tpu", "axon"),
+        use_pallas=use_pallas,
     )
     ty = get_type("set_aw")
     rng = np.random.default_rng(7)
     d = cfg.max_dcs
     bw = ty.eff_b_width(cfg)
-    log(f"bench: platform={platform} n_keys={n_keys} shards={n_shards}")
+    log(f"child: platform={platform} devices={n_dev} n_keys={n_keys} "
+        f"shards={n_shards} use_pallas={use_pallas}")
     n_rows = (n_keys + n_shards - 1) // n_shards
     table = TypedTable(ty, cfg, n_rows=n_rows, n_shards=n_shards)
     for s in range(n_shards):
@@ -177,41 +258,41 @@ def child(args):
     first_add_vc[valid_first] = lane0[first_idx[valid_first]]
     first_add_elem[valid_first] = elems[first_idx[valid_first]]
 
-    t0 = time.perf_counter()
-    zeros_b = np.zeros((pop_batch, bw), np.int32)
-    for lo in range(0, total, pop_batch):
-        hi = min(lo + pop_batch, total)
-        m = hi - lo
-        vcs = np.zeros((m, d), np.int32)
-        vcs[:, 0] = lane0[lo:hi]
-        ss, rr = srows(keys[lo:hi])
-        table.append(ss, rr, elems[lo:hi, None], zeros_b[:m], vcs,
-                     np.zeros(m, np.int32))
-        if (lo // pop_batch) % 50 == 0:
-            log(f"populate: {hi}/{total}")
-    clock0 = total
-    rm_keys = rng.choice(n_keys, size=n_keys // 10, replace=False).astype(np.int64)
-    rm_keys = rm_keys[valid_first[rm_keys]]
-    nrm = rm_keys.shape[0]
-    for lo in range(0, nrm, pop_batch):
-        hi = min(lo + pop_batch, nrm)
-        m = hi - lo
-        kk = rm_keys[lo:hi]
-        eff_b = np.zeros((m, bw), np.int32)
-        eff_b[:, 0] = 1
-        eff_b[:, 1] = first_add_vc[kk]
-        vcs = np.zeros((m, d), np.int32)
-        vcs[:, 0] = clock0 + 1 + lo + np.arange(m, dtype=np.int32)
-        ss, rr = srows(kk)
-        table.append(ss, rr, first_add_elem[kk, None], eff_b, vcs,
-                     np.zeros(m, np.int32))
-    final_t = clock0 + nrm
-    final_clock = np.zeros(d, np.int32)
-    final_clock[0] = final_t
-    mid_t = int(total * 0.6)  # historical point: 60% through the add stream
-    mid_clock = np.zeros(d, np.int32)
-    mid_clock[0] = mid_t
-    log(f"populate: {total + nrm} ops in {time.perf_counter() - t0:.1f}s")
+    with phase("populate"):
+        zeros_b = np.zeros((pop_batch, bw), np.int32)
+        for lo in range(0, total, pop_batch):
+            hi = min(lo + pop_batch, total)
+            m = hi - lo
+            vcs = np.zeros((m, d), np.int32)
+            vcs[:, 0] = lane0[lo:hi]
+            ss, rr = srows(keys[lo:hi])
+            table.append(ss, rr, elems[lo:hi, None], zeros_b[:m], vcs,
+                         np.zeros(m, np.int32))
+            if (lo // pop_batch) % 50 == 0:
+                log(f"populate: {hi}/{total}")
+        clock0 = total
+        rm_keys = rng.choice(n_keys, size=n_keys // 10, replace=False).astype(np.int64)
+        rm_keys = rm_keys[valid_first[rm_keys]]
+        nrm = rm_keys.shape[0]
+        for lo in range(0, nrm, pop_batch):
+            hi = min(lo + pop_batch, nrm)
+            m = hi - lo
+            kk = rm_keys[lo:hi]
+            eff_b = np.zeros((m, bw), np.int32)
+            eff_b[:, 0] = 1
+            eff_b[:, 1] = first_add_vc[kk]
+            vcs = np.zeros((m, d), np.int32)
+            vcs[:, 0] = clock0 + 1 + lo + np.arange(m, dtype=np.int32)
+            ss, rr = srows(kk)
+            table.append(ss, rr, first_add_elem[kk, None], eff_b, vcs,
+                         np.zeros(m, np.int32))
+        final_t = clock0 + nrm
+        final_clock = np.zeros(d, np.int32)
+        final_clock[0] = final_t
+        mid_t = int(total * 0.6)  # historical point: 60% through the add stream
+        mid_clock = np.zeros(d, np.int32)
+        mid_clock[0] = mid_t
+        log(f"populate: {total + nrm} ops total")
 
     # ---- host Zipfian sampler (the serving path routes on host) ----
     w = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** 1.0
@@ -232,39 +313,48 @@ def child(args):
         vcs = vc_mid_b if (i % hist_every == hist_every - 1) else vc_final_b
         return table.read_resolved_raw(ss, rr, vcs)
 
-    # warmup/compile both VC variants
-    for i in (0, hist_every - 1):
-        resolved, fresh, complete, pos = serve_one(i)
+    # warmup/compile both VC variants; timed separately so a compile hang
+    # (vs execute hang) localizes itself in the logs
+    with phase("warmup_serve_fresh"):
+        resolved, fresh, complete, pos = serve_one(0)
+        np.asarray(resolved["top"])
+    with phase("warmup_serve_hist"):
+        resolved, fresh, complete, pos = serve_one(hist_every - 1)
         np.asarray(resolved["top"])
     # unpipelined per-batch latency
     lat = []
     stale_hist = []
-    for i in range(6):
-        tb = time.perf_counter()
-        resolved, fresh, complete, pos = serve_one(i)
-        np.asarray(resolved["top"]), np.asarray(resolved["count"])
-        lat.append(time.perf_counter() - tb)
-        if i % hist_every == hist_every - 1:
-            f = np.asarray(fresh)[pos[:, 0], pos[:, 1]]
-            stale_hist.append(1.0 - f.mean())
+    with phase("serve_latency"):
+        for i in range(6):
+            tb = time.perf_counter()
+            resolved, fresh, complete, pos = serve_one(i)
+            np.asarray(resolved["top"]), np.asarray(resolved["count"])
+            lat.append(time.perf_counter() - tb)
+            log(f"serve_latency batch {i}: {lat[-1] * 1e3:.1f}ms")
+            if i % hist_every == hist_every - 1:
+                f = np.asarray(fresh)[pos[:, 0], pos[:, 1]]
+                stale_hist.append(1.0 - f.mean())
     lat_ms = np.asarray(lat) * 1e3
     # pipelined throughput (≈ basho_bench's concurrent workers)
     import collections
 
     q = collections.deque()
     depth = 8
-    t0 = time.perf_counter()
-    for i in range(serve_batches):
-        resolved, fresh, complete, pos = serve_one(i)
-        for x in resolved.values():
-            x.copy_to_host_async()
-        q.append(resolved)
-        if len(q) > depth:
-            old = q.popleft()
-            np.asarray(old["top"])
-    while q:
-        np.asarray(q.popleft()["top"])
-    serve_elapsed = time.perf_counter() - t0
+    with phase("serve_pipeline"):
+        t0 = time.perf_counter()
+        for i in range(serve_batches):
+            resolved, fresh, complete, pos = serve_one(i)
+            for x in resolved.values():
+                x.copy_to_host_async()
+            q.append(resolved)
+            if len(q) > depth:
+                old = q.popleft()
+                np.asarray(old["top"])
+            if i % 10 == 9:
+                log(f"serve_pipeline: {i + 1}/{serve_batches}")
+        while q:
+            np.asarray(q.popleft()["top"])
+        serve_elapsed = time.perf_counter() - t0
     serving_rps = serve_batches * serve_batch / serve_elapsed
     log(f"serving path: {serving_rps:,.0f} reads/s "
         f"(batch={serve_batch}, hist 1/{hist_every}, "
@@ -296,45 +386,52 @@ def child(args):
         )
 
     prng = jax.random.PRNGKey(3)
-    for _ in range(3):
-        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
-        np.asarray(ev)
+    with phase("warmup_device_kernel"):
+        for _ in range(3):
+            prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
+            np.asarray(ev)
     rtt = []
-    for _ in range(5):
-        tb = time.perf_counter()
-        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
-        np.asarray(ev)
-        rtt.append(time.perf_counter() - tb)
+    with phase("device_latency"):
+        for _ in range(5):
+            tb = time.perf_counter()
+            prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
+            np.asarray(ev)
+            rtt.append(time.perf_counter() - tb)
     rtt_ms = np.asarray(rtt) * 1e3
     q = collections.deque()
     depth = 32
-    t0 = time.perf_counter()
-    for _ in range(device_batches):
-        prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
-        ev.copy_to_host_async()
-        q.append(ev)
-        if len(q) > depth:
+    with phase("device_pipeline"):
+        t0 = time.perf_counter()
+        for i in range(device_batches):
+            prng, ev = device_step(prng, cdf_dev, he, ha, hr, ho)
+            ev.copy_to_host_async()
+            q.append(ev)
+            if len(q) > depth:
+                np.asarray(q.popleft())
+            if i % 100 == 99:
+                log(f"device_pipeline: {i + 1}/{device_batches}")
+        while q:
             np.asarray(q.popleft())
-    while q:
-        np.asarray(q.popleft())
-    device_rps = device_batches * device_batch / (time.perf_counter() - t0)
+        device_elapsed = time.perf_counter() - t0
+    device_rps = device_batches * device_batch / device_elapsed
     log(f"device kernel: {device_rps:,.0f} reads/s  "
         f"rtt p50={np.percentile(rtt_ms, 50):.2f}ms")
 
     # =======================================================================
     # baseline: sequential host materializer (reference-style walk)
     # =======================================================================
-    ops_by_key = {}
-    for i in range(total):
-        ops_by_key.setdefault(int(keys[i]), []).append(
-            ({"dc0": int(lane0[i])}, "add", int(elems[i]))
-        )
-    for j in range(nrm):
-        k = int(rm_keys[j])
-        ops_by_key.setdefault(k, []).append(
-            ({"dc0": int(clock0 + 1 + j)}, "rm",
-             (int(first_add_elem[k]), {"dc0": int(first_add_vc[k])}))
-        )
+    with phase("baseline_build"):
+        ops_by_key = {}
+        for i in range(total):
+            ops_by_key.setdefault(int(keys[i]), []).append(
+                ({"dc0": int(lane0[i])}, "add", int(elems[i]))
+            )
+        for j in range(nrm):
+            k = int(rm_keys[j])
+            ops_by_key.setdefault(k, []).append(
+                ({"dc0": int(clock0 + 1 + j)}, "rm",
+                 (int(first_add_elem[k]), {"dc0": int(first_add_vc[k])}))
+            )
 
     def baseline_read(k, read_vc_dict):
         # the reference fold: per-op dict-VC dominance check, then apply
@@ -360,30 +457,32 @@ def child(args):
     final_vc_dict = {"dc0": final_t}
     mid_vc_dict = {"dc0": mid_t}
     bkeys = sample(baseline_reads)
-    t0 = time.perf_counter()
-    for k in bkeys:
-        baseline_read(int(k), final_vc_dict)
-    base_rps = baseline_reads / (time.perf_counter() - t0)
+    with phase("baseline_run"):
+        t0 = time.perf_counter()
+        for k in bkeys:
+            baseline_read(int(k), final_vc_dict)
+        base_rps = baseline_reads / (time.perf_counter() - t0)
     log(f"baseline(host python per-key fold): {base_rps:,.0f} reads/s")
 
     # ---- correctness spot-check: serving values == host materializer ----
-    for at_clock, at_dict, tag in (
-        (final_clock, final_vc_dict, "final"),
-        (mid_clock, mid_vc_dict, "historical"),
-    ):
-        chk = bkeys[:32].astype(np.int64)
-        ss, rr = srows(chk)
-        out, fresh, complete = table.read_resolved(
-            ss, rr, np.broadcast_to(at_clock, (32, d))
-        )
-        assert complete.all()
-        for i, k in enumerate(chk):
-            ref = sorted(baseline_read(int(k), at_dict))
-            cnt = int(out["count"][i])
-            dev = sorted(int(e) for e in out["top"][i] if e != 0)
-            assert cnt == len(ref), (tag, int(k), cnt, len(ref))
-            if cnt <= ty.resolve_top:
-                assert dev == ref, (tag, int(k), dev, ref)
+    with phase("spot_check"):
+        for at_clock, at_dict, tag in (
+            (final_clock, final_vc_dict, "final"),
+            (mid_clock, mid_vc_dict, "historical"),
+        ):
+            chk = bkeys[:32].astype(np.int64)
+            ss, rr = srows(chk)
+            out, fresh, complete = table.read_resolved(
+                ss, rr, np.broadcast_to(at_clock, (32, d))
+            )
+            assert complete.all()
+            for i, k in enumerate(chk):
+                ref = sorted(baseline_read(int(k), at_dict))
+                cnt = int(out["count"][i])
+                dev = sorted(int(e) for e in out["top"][i] if e != 0)
+                assert cnt == len(ref), (tag, int(k), cnt, len(ref))
+                if cnt <= ty.resolve_top:
+                    assert dev == ref, (tag, int(k), dev, ref)
     log("spot-check: serving values match host materializer "
         "(fresh + historical) on 64 keys")
 
@@ -405,6 +504,7 @@ def child(args):
         "device_rtt_p50_ms": round(float(np.percentile(rtt_ms, 50)), 2),
         "use_pallas": bool(cfg.use_pallas),
         "platform": platform,
+        "phases_s": phases,
     }))
     return 0
 
@@ -413,6 +513,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small, fast run")
     ap.add_argument("--keys", type=int, default=None)
+    ap.add_argument("--pallas", choices=("auto", "on", "off"), default="auto",
+                    help="force the Pallas in-path dispatch on/off "
+                         "(auto = on iff TPU)")
+    ap.add_argument("--no-pallas-upgrade", action="store_true",
+                    help="parent: skip the Pallas upgrade attempt")
     ap.add_argument("--child", action="store_true",
                     help="internal: run the measured workload in-process")
     args = ap.parse_args()
