@@ -132,6 +132,76 @@ class CRDTType(abc.ABC):
         traced inside the serving read kernel."""
         raise NotImplementedError(f"{self.name} has no device resolution")
 
+    def value_from_resolved(
+        self, resolved: Dict[str, np.ndarray], blobs: BlobStore,
+        cfg: AntidoteConfig,
+    ) -> Any:
+        """Client-visible value reconstructed from ONE key's compact
+        device-resolved view (``resolve_spec`` layout) — the host half of
+        the serving read path (cure:transform_reads,
+        /root/reference/src/cure.erl:186-192): the device ran ``resolve``,
+        only the compact view crossed the tunnel, and this turns it into
+        the same value ``value`` would return from the full state.
+
+        Returns :data:`RESOLVE_OVERFLOW` when the compact view is
+        truncated (count > ``resolve_top``) and the caller must re-fetch
+        the full state.  Only called for types with a ``resolve_spec``."""
+        raise NotImplementedError(f"{self.name} has no resolved decoding")
+
+
+#: sentinel: the compact resolved view was truncated; re-fetch full state
+RESOLVE_OVERFLOW = object()
+
+
+def warn_overflow(type_name: str, ovf: int, stacklevel: int = 3) -> None:
+    """Surface element-slot exhaustion (the device apply dropped ``ovf``
+    ops).  Raising would make the key unreadable; warn loudly instead —
+    growth + WAL replay is the recovery path."""
+    if ovf > 0:
+        import warnings
+
+        warnings.warn(
+            f"{type_name}: {ovf} op(s) dropped — cfg slots exhausted "
+            "for this key; increase the slot budget (data until then is "
+            "truncated)",
+            RuntimeWarning,
+            stacklevel=stacklevel,
+        )
+
+
+def warn_overflow_state(type_name: str, state) -> None:
+    """Slot-exhaustion warning from a full host state copy (the
+    resolved-view twin lives in :class:`TopCountResolved`)."""
+    warn_overflow(type_name, int(np.asarray(state.get("ovf", 0))),
+                  stacklevel=4)
+
+
+def value_from_top(resolved, blobs: BlobStore, top: int):
+    """Shared ``value_from_resolved`` body for top-k/count multi-element
+    types (sets, mv-register): resolve the packed handles, or signal
+    overflow when the true count exceeds the compacted lanes."""
+    count = int(resolved["count"])
+    if count > top:
+        return RESOLVE_OVERFLOW
+    handles = np.asarray(resolved["top"]).reshape(-1)
+    return sorted(
+        (blobs.resolve(int(h)) for h in handles if h != 0), key=repr
+    )
+
+
+class TopCountResolved:
+    """Mixin for slotted multi-element types whose compact device view is
+    ``{top, count, ovf}``: decode via :func:`value_from_top`, preserving
+    the slot-exhaustion warning the full-state ``value`` path emits."""
+
+    def value_from_resolved(self, resolved, blobs, cfg):
+        v = value_from_top(resolved, blobs, self.resolve_top)
+        if v is not RESOLVE_OVERFLOW:
+            # truncated views re-fetch full state and warn in value();
+            # warning here too would double-fire for one read
+            warn_overflow(self.name, int(np.asarray(resolved.get("ovf", 0))))
+        return v
+
 
 def compact_top(elems, present, top: int):
     """Compact a slotted multi-element value view on device.

@@ -64,6 +64,9 @@ class CounterPN(CRDTType):
     def resolve(self, cfg, state):
         return {"value": state["cnt"]}
 
+    def value_from_resolved(self, resolved, blobs, cfg):
+        return int(resolved["value"])
+
 
 class CounterFat(CRDTType):
     """PN counter with reset ("fat" counter).
@@ -125,6 +128,9 @@ class CounterFat(CRDTType):
 
     def resolve(self, cfg, state):
         return {"value": jnp.sum(state["amt"], axis=-1)}
+
+    def value_from_resolved(self, resolved, blobs, cfg):
+        return int(resolved["value"])
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs

@@ -92,6 +92,9 @@ class FlagEW(_FlagAssocMixin, _FlagBase):
         on = jnp.any(state["envc"] > state["disvc"], axis=-1)
         return {"value": on.astype(jnp.int32)}
 
+    def value_from_resolved(self, resolved, blobs, cfg):
+        return bool(int(resolved["value"]))
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
         envc, disvc = state["envc"], state["disvc"]
@@ -141,6 +144,9 @@ class FlagDW(_FlagAssocMixin, _FlagBase):
         envc, disvc = state["envc"], state["disvc"]
         on = jnp.any(envc > 0, axis=-1) & jnp.all(envc >= disvc, axis=-1)
         return {"value": on.astype(jnp.int32)}
+
+    def value_from_resolved(self, resolved, blobs, cfg):
+        return bool(int(resolved["value"]))
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs

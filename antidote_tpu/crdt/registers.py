@@ -14,7 +14,8 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
-from antidote_tpu.crdt.base import CRDTType, Effect, pack_a, pack_b
+from antidote_tpu.crdt.base import (CRDTType, Effect, TopCountResolved,
+                                    pack_a, pack_b)
 from antidote_tpu.crdt.blob import EMPTY_HANDLE
 
 
@@ -62,6 +63,9 @@ class RegisterLWW(CRDTType):
         # the handle; the host resolves it to the payload via the blob store
         return {"value": state["val"]}
 
+    def value_from_resolved(self, resolved, blobs, cfg):
+        return blobs.resolve(int(resolved["value"]))
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         h, ts = eff_a[0], eff_a[1]
         newer = (ts > state["ts"]) | ((ts == state["ts"]) & (h > state["val"]))
@@ -71,7 +75,7 @@ class RegisterLWW(CRDTType):
         }
 
 
-class RegisterMV(CRDTType):
+class RegisterMV(TopCountResolved, CRDTType):
     """Multi-value register.
 
     Each live entry has a unique id = (origin_dc, commit counter at origin)
@@ -115,9 +119,9 @@ class RegisterMV(CRDTType):
         return [(a, pack_b([], width=self.eff_b_width(cfg)), [(h, blobs.bytes_of(h))])]
 
     def value(self, state, blobs, cfg):
-        from antidote_tpu.crdt.sets import _warn_overflow
+        from antidote_tpu.crdt.base import warn_overflow_state
 
-        _warn_overflow(self.name, state)
+        warn_overflow_state(self.name, state)
         vals = np.asarray(state["vals"])
         ids = np.asarray(state["ids"])
         out = [blobs.resolve(int(v)) for v, i in zip(vals, ids) if i != 0]
@@ -125,7 +129,8 @@ class RegisterMV(CRDTType):
 
     def resolve_spec(self, cfg):
         t = self.resolve_top
-        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32),
+                "ovf": ((), jnp.int32)}
 
     def resolve(self, cfg, state):
         from antidote_tpu.crdt.base import compact_top
@@ -133,7 +138,7 @@ class RegisterMV(CRDTType):
         top, count = compact_top(
             state["vals"], state["ids"] != 0, self.resolve_top
         )
-        return {"top": top, "count": count}
+        return {"top": top, "count": count, "ovf": state["ovf"]}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         k = cfg.mv_slots

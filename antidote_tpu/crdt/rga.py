@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from antidote_tpu.crdt.base import CRDTType, Effect
-from antidote_tpu.crdt.sets import _warn_overflow
+from antidote_tpu.crdt.base import warn_overflow_state
 
 _INSERT, _DELETE = 0, 1
 _HEAD_UID = 0  # insert at the very front
@@ -95,7 +95,7 @@ class RGA(CRDTType):
         return [(a, b, [(h, blobs.bytes_of(h))])]
 
     def value(self, state, blobs, cfg):
-        _warn_overflow(self.name, state)
+        warn_overflow_state(self.name, state)
         visible, _ = self._visible_positions(state)
         elems = np.asarray(state["elem"])
         return [blobs.resolve(int(elems[i])) for i in visible]

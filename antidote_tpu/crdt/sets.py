@@ -29,24 +29,9 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
-from antidote_tpu.crdt.base import CRDTType, Effect, compact_top, pack_b
+from antidote_tpu.crdt.base import (CRDTType, Effect, TopCountResolved,
+                                    compact_top, pack_b, warn_overflow_state)
 from antidote_tpu.crdt.blob import EMPTY_HANDLE
-
-
-def _warn_overflow(type_name, state):
-    """Surface element-slot exhaustion (device apply drops the op and bumps
-    the ``ovf`` counter).  Raising here would make the key unreadable;
-    instead we warn loudly — growth + WAL replay is the recovery path."""
-    ovf = int(np.asarray(state.get("ovf", 0)))
-    if ovf > 0:
-        import warnings
-
-        warnings.warn(
-            f"{type_name}: {ovf} op(s) dropped — cfg.set_slots exhausted "
-            "for this key; increase set_slots (data until then is truncated)",
-            RuntimeWarning,
-            stacklevel=3,
-        )
 
 
 def _elem_effects(op, blobs, make):
@@ -56,7 +41,7 @@ def _elem_effects(op, blobs, make):
     return [make(arg)]
 
 
-class SetAW(CRDTType):
+class SetAW(TopCountResolved, CRDTType):
     """Add-wins OR-set.
 
     Effect lanes: eff_a = [handle]; eff_b = [kind(0=add,1=rm),
@@ -104,7 +89,7 @@ class SetAW(CRDTType):
         return _elem_effects(op, blobs, make)
 
     def value(self, state, blobs, cfg):
-        _warn_overflow(self.name, state)
+        warn_overflow_state(self.name, state)
         elems = np.asarray(state["elems"])
         present = np.any(
             np.asarray(state["addvc"]) > np.asarray(state["rmvc"]), axis=-1
@@ -113,7 +98,8 @@ class SetAW(CRDTType):
 
     def resolve_spec(self, cfg):
         t = self.resolve_top
-        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32),
+                "ovf": ((), jnp.int32)}
 
     def resolve(self, cfg, state):
         """Device OR-set presence + compaction.  With ``cfg.use_pallas`` the
@@ -140,7 +126,7 @@ class SetAW(CRDTType):
             present = jnp.any(state["addvc"] > state["rmvc"], axis=-1)
             present = present & (elems != EMPTY_HANDLE)
         top, count = compact_top(elems, present, self.resolve_top)
-        return {"top": top, "count": count}
+        return {"top": top, "count": count, "ovf": state["ovf"]}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
@@ -182,7 +168,7 @@ class SetAW(CRDTType):
         }
 
 
-class SetRW(CRDTType):
+class SetRW(TopCountResolved, CRDTType):
     """Remove-wins set.
 
     Effect lanes: eff_a = [handle]; eff_b = [kind(0=add,1=rm),
@@ -236,14 +222,15 @@ class SetRW(CRDTType):
         return (np.asarray(elems) != EMPTY_HANDLE) & has_add & covered
 
     def value(self, state, blobs, cfg):
-        _warn_overflow(self.name, state)
+        warn_overflow_state(self.name, state)
         elems = np.asarray(state["elems"])
         present = self._present(elems, state["addvc"], state["rmvc"])
         return sorted((blobs.resolve(int(h)) for h in elems[present]), key=repr)
 
     def resolve_spec(self, cfg):
         t = self.resolve_top
-        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32),
+                "ovf": ((), jnp.int32)}
 
     def resolve(self, cfg, state):
         elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
@@ -251,7 +238,7 @@ class SetRW(CRDTType):
         covered = jnp.all(addvc >= rmvc, axis=-1)
         present = (elems != EMPTY_HANDLE) & has_add & covered
         top, count = compact_top(elems, present, self.resolve_top)
-        return {"top": top, "count": count}
+        return {"top": top, "count": count, "ovf": state["ovf"]}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
@@ -293,7 +280,7 @@ class SetRW(CRDTType):
         }
 
 
-class SetGO(CRDTType):
+class SetGO(TopCountResolved, CRDTType):
     """Grow-only set: slots fill monotonically."""
 
     name = "set_go"
@@ -320,7 +307,7 @@ class SetGO(CRDTType):
         return _elem_effects(op, blobs, make)
 
     def value(self, state, blobs, cfg):
-        _warn_overflow(self.name, state)
+        warn_overflow_state(self.name, state)
         elems = np.asarray(state["elems"])
         return sorted(
             (blobs.resolve(int(h)) for h in elems[elems != EMPTY_HANDLE]), key=repr
@@ -328,12 +315,13 @@ class SetGO(CRDTType):
 
     def resolve_spec(self, cfg):
         t = self.resolve_top
-        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32)}
+        return {"top": ((t,), jnp.int64), "count": ((), jnp.int32),
+                "ovf": ((), jnp.int32)}
 
     def resolve(self, cfg, state):
         elems = state["elems"]
         top, count = compact_top(elems, elems != EMPTY_HANDLE, self.resolve_top)
-        return {"top": top, "count": count}
+        return {"top": top, "count": count, "ovf": state["ovf"]}
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         elems = state["elems"]

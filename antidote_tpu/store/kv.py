@@ -283,7 +283,8 @@ class KVStore:
         return {f: x.copy() for f, x in hit.items()}
 
     def read_resolved(
-        self, objects: Sequence[BoundObject], read_vc: np.ndarray
+        self, objects: Sequence[BoundObject], read_vc: np.ndarray,
+        full_out: Dict[int, Dict[str, np.ndarray]] | None = None,
     ) -> List[Dict[str, np.ndarray]]:
         """Serving fast path: batched reads with DEVICE value resolution.
 
@@ -294,7 +295,12 @@ class KVStore:
         (materializer_vnode:read + cure:transform_reads).  Types without a
         ``resolve_spec`` return their full state; rows below retained
         device coverage fall back to the host log replay + host-side
-        resolution."""
+        resolution.
+
+        When ``full_out`` is given, full states rebuilt by the replay
+        fallback are also recorded there keyed by object index — callers
+        that might need the full state anyway (e.g. a truncated resolved
+        view) must not pay a second WAL scan for it."""
         read_vc = np.asarray(read_vc, np.int32)
         out: List[Dict[str, np.ndarray] | None] = [None] * len(objects)
         by_type: Dict[str, list] = {}
@@ -328,7 +334,13 @@ class KVStore:
                     reps = self._replay_read_many(shard, wants, read_vc)
                     for j, rep in reps.items():
                         gi = items[j][0]
-                        if ty.resolve_spec(self.cfg) is not None:
+                        if full_out is not None:
+                            # caller decodes the full state directly; a
+                            # host-side resolve launch here would be
+                            # wasted work on the replay (slowest) branch
+                            full_out[gi] = rep
+                            out[gi] = rep
+                        elif ty.resolve_spec(self.cfg) is not None:
                             out[gi] = {
                                 f: np.asarray(x)
                                 for f, x in ty.resolve(self.cfg, rep).items()
@@ -436,10 +448,11 @@ class KVStore:
     def stable_vc(self) -> np.ndarray:
         """DC-wide stable snapshot = entry-wise min of per-shard clocks
         (stable_time_functions:get_min_time,
-        /root/reference/src/stable_time_functions.erl:51-85).  At
-        ``n_shards`` rows the host min always wins; the large-matrix min
-        (many nodes × shards) goes through :func:`stable_min_of`."""
-        return self.applied_vc.min(axis=0)
+        /root/reference/src/stable_time_functions.erl:51-85).  Routed
+        through :func:`stable_min_of`, which keeps the usual
+        ``n_shards``-row matrix on host and dispatches large matrices
+        (many nodes × shards) to the streaming Pallas kernel."""
+        return stable_min_of(self.applied_vc, getattr(self.cfg, "use_pallas", False))
 
     def dc_max_vc(self) -> np.ndarray:
         """Entry-wise max of per-shard clocks — the freshest local view."""

@@ -138,20 +138,24 @@ def parent(args):
             and not args.no_pallas_upgrade):
         t_pallas = max(t_pallas, int(land_wall * 1.5) + 120)
         t_pallas = int(min(t_pallas, total_left))
-    if (got.get("platform") in ("tpu", "axon") and args.pallas == "auto"
-            and not args.no_pallas_upgrade and t_pallas >= 180):
-        up, unote = _run_attempt(smoke + keyarg + ["--pallas", "on"], {}, t_pallas)
-        if up is not None and up.get("value", 0) > got.get("value", 0):
-            up["pallas_upgrade"] = (
-                f"+{(up['value'] / max(got['value'], 1) - 1) * 100:.0f}% over XLA path"
+        if t_pallas >= 180:
+            up, unote = _run_attempt(
+                smoke + keyarg + ["--pallas", "on"], {}, t_pallas
             )
-            got = up
-        elif up is not None:
-            got["pallas_attempt"] = (
-                f"completed but not faster ({up.get('value')} reads/s)"
-            )
+            if up is not None and up.get("value", 0) > got.get("value", 0):
+                up["pallas_upgrade"] = (
+                    f"+{(up['value'] / max(got['value'], 1) - 1) * 100:.0f}% "
+                    "over XLA path"
+                )
+                got = up
+            elif up is not None:
+                got["pallas_attempt"] = (
+                    f"completed but not faster ({up.get('value')} reads/s)"
+                )
+            else:
+                got["pallas_attempt"] = f"failed: {unote}"
         else:
-            got["pallas_attempt"] = f"failed: {unote}"
+            got["pallas_attempt"] = "skipped: no wall-clock budget left"
     if notes:
         got["error"] = "; ".join(notes) + " (recovered)"
     print(json.dumps(got))

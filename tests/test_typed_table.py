@@ -335,4 +335,4 @@ def test_set_slot_overflow_warns(cfg):
         _w.simplefilter("always")
         v = d.value(0, d.clock)
     assert len(v) == cfg.set_slots
-    assert any("set_slots exhausted" in str(r.message) for r in rec)
+    assert any("op(s) dropped" in str(r.message) for r in rec)
