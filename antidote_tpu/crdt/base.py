@@ -132,6 +132,31 @@ class CRDTType(abc.ABC):
         traced inside the serving read kernel."""
         raise NotImplementedError(f"{self.name} has no device resolution")
 
+    # ---- slot accounting (the overflow escape hatch) -------------------
+    # The reference's slotted analogues (sets, maps, mv-register, rga)
+    # grow without bound; fixed device layouts cannot.  Instead of
+    # dropping ops on slot exhaustion, the store PROMOTES a key to a
+    # wider-slot sibling table before appending (KVStore._promote_key),
+    # driven by a host-side conservative bound: ``slot_demand`` ops may
+    # each claim a fresh slot, so bound_after = bound + demand; when that
+    # exceeds ``slot_capacity`` the key migrates and the bound resets to
+    # ``used_slots`` (exact, from the head state).  The bound only ever
+    # over-counts, so no op is ever dropped.
+
+    def slot_capacity(self, cfg: AntidoteConfig):
+        """Max element slots a key of this type holds at ``cfg``'s widths,
+        or ``None`` for unslotted types (counters, flags, lww)."""
+        return None
+
+    def slot_demand(self, eff_a, eff_b) -> int:
+        """How many fresh slots this one effect may claim (host, 0/1)."""
+        return 0
+
+    def used_slots(self, state: Dict[str, np.ndarray]) -> int:
+        """Exact count of slots an incoming add cannot claim, from a host
+        copy of the key's head state."""
+        return 0
+
     def value_from_resolved(
         self, resolved: Dict[str, np.ndarray], blobs: BlobStore,
         cfg: AntidoteConfig,

@@ -140,6 +140,15 @@ class RegisterMV(TopCountResolved, CRDTType):
         )
         return {"top": top, "count": count, "ovf": state["ovf"]}
 
+    def slot_capacity(self, cfg):
+        return cfg.mv_slots
+
+    def slot_demand(self, eff_a, eff_b):
+        return 1  # each assign inserts one entry (after dropping observed)
+
+    def used_slots(self, state):
+        return int((np.asarray(state["ids"]) != 0).sum())
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         k = cfg.mv_slots
         vals, ids = state["vals"], state["ids"]

@@ -94,6 +94,17 @@ class RGA(CRDTType):
         a[1] = origin_uid
         return [(a, b, [(h, blobs.bytes_of(h))])]
 
+    def slot_capacity(self, cfg):
+        return cfg.rga_slots
+
+    def slot_demand(self, eff_a, eff_b):
+        return 1 if int(eff_b[0]) == _INSERT else 0
+
+    def used_slots(self, state):
+        # occupancy is a contiguous prefix (inserts shift right);
+        # tombstones still occupy their slot
+        return int((np.asarray(state["uid"]) != 0).sum())
+
     def value(self, state, blobs, cfg):
         warn_overflow_state(self.name, state)
         visible, _ = self._visible_positions(state)

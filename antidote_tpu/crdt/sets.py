@@ -128,6 +128,19 @@ class SetAW(TopCountResolved, CRDTType):
         top, count = compact_top(elems, present, self.resolve_top)
         return {"top": top, "count": count, "ovf": state["ovf"]}
 
+    def slot_capacity(self, cfg):
+        return cfg.set_slots
+
+    def slot_demand(self, eff_a, eff_b):
+        return 1 if int(eff_b[0]) == 0 else 0  # adds may claim a slot
+
+    def used_slots(self, state):
+        # an add can reclaim any non-present slot (apply's free mask)
+        present = np.any(
+            np.asarray(state["addvc"]) > np.asarray(state["rmvc"]), axis=-1
+        ) & (np.asarray(state["elems"]) != EMPTY_HANDLE)
+        return int(present.sum())
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
         elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
@@ -240,6 +253,16 @@ class SetRW(TopCountResolved, CRDTType):
         top, count = compact_top(elems, present, self.resolve_top)
         return {"top": top, "count": count, "ovf": state["ovf"]}
 
+    def slot_capacity(self, cfg):
+        return cfg.set_slots
+
+    def slot_demand(self, eff_a, eff_b):
+        return 1  # adds and removes may both claim a slot (rw tombstones)
+
+    def used_slots(self, state):
+        # rw slots are reclaimed only when fully empty (apply's free mask)
+        return int((np.asarray(state["elems"]) != EMPTY_HANDLE).sum())
+
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         d = cfg.max_dcs
         elems, addvc, rmvc = state["elems"], state["addvc"], state["rmvc"]
@@ -322,6 +345,15 @@ class SetGO(TopCountResolved, CRDTType):
         elems = state["elems"]
         top, count = compact_top(elems, elems != EMPTY_HANDLE, self.resolve_top)
         return {"top": top, "count": count, "ovf": state["ovf"]}
+
+    def slot_capacity(self, cfg):
+        return cfg.set_slots
+
+    def slot_demand(self, eff_a, eff_b):
+        return 1
+
+    def used_slots(self, state):
+        return int((np.asarray(state["elems"]) != EMPTY_HANDLE).sum())
 
     def apply(self, cfg, state, eff_a, eff_b, commit_vc, origin_dc):
         elems = state["elems"]

@@ -47,6 +47,7 @@ def _table_slice(t, shard: int, used: int) -> Dict[str, Any]:
         # provably-fresh fast path would trust stale bounds
         "max_abs_delta": int(t.max_abs_delta),
         "max_commit_vc": t.max_commit_vc.copy(),
+        "slots_ub": t.slots_ub[shard, :used].copy(),
     }
     return out
 
@@ -153,6 +154,13 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
         t.ops_origin = t.ops_origin.at[dst, base:end].set(sl["ops_origin"])
         t.head_vc = t.head_vc.at[dst, base:end].set(sl["head_vc"])
         t.n_ops[dst, base:end] = sl["n_ops"]
+        # packages from builds predating the overflow hatch lack the slot
+        # bound; the conservative default (capacity) forces a promotion on
+        # the next add rather than risking a drop
+        cap = t.ty.slot_capacity(t.cfg)
+        t.slots_ub[dst, base:end] = np.asarray(
+            sl.get("slots_ub", np.full(used, cap or 0, np.int32)), np.int32
+        )
         t.used_rows[dst] = end
         # packages from builds predating these gates lack the keys; the
         # conservative defaults disable the Pallas counter dispatch and the
@@ -207,6 +215,7 @@ def drop_shard(store: KVStore, shard: int) -> None:
             t.ops_origin = t.ops_origin.at[shard].set(0)
             t.head_vc = t.head_vc.at[shard].set(0)
             t.n_ops[shard] = 0
+            t.slots_ub[shard] = 0
         t.used_rows[shard] = 0
     store.directory = {
         dk: ent for dk, ent in store.directory.items() if ent[1] != shard
@@ -305,6 +314,7 @@ def reshard(store: KVStore, new_cfg, log=None) -> KVStore:
         dst.head_vc = dst.head_vc.at[ns, nr].set(
             np.asarray(src.head_vc)[old_s, old_r])
         dst.n_ops[ns, nr] = src.n_ops[old_s, old_r]
+        dst.slots_ub[ns, nr] = src.slots_ub[old_s, old_r]
         dst.next_seq = max(dst.next_seq, src.next_seq)
         dst.max_abs_delta = max(dst.max_abs_delta, src.max_abs_delta)
         np.maximum(dst.max_commit_vc, src.max_commit_vc,

@@ -27,6 +27,46 @@ BoundObject = Tuple[Any, str, str]  # (key, type_name, bucket)
 #: below this many clock rows the host numpy min beats a device launch
 _PALLAS_MIN_ROWS = 2048
 
+# ---------------------------------------------------------------------------
+# slot tiers — the overflow escape hatch
+#
+# The reference's slotted types (sets, maps, mv-register, rga) are
+# unbounded; fixed device layouts are not.  A key that outgrows its slot
+# budget is PROMOTED to a wider-slot sibling table (slot widths x4 per
+# tier) BEFORE any op would be dropped (SURVEY §7 "slotted layouts +
+# overflow-to-host escape hatch", matching unbounded antidote_crdt_set_aw
+# semantics).  The tier rides in the table name ("set_aw#2"), so the
+# directory entry shape, handoff packages and reshard stay unchanged.
+# ---------------------------------------------------------------------------
+_TIER_SCALE = 4
+_MAX_TIER = 8  # 4^8 = 65536x the base slot width
+
+
+def split_tier(tname: str) -> Tuple[str, int]:
+    """"set_aw#2" -> ("set_aw", 2); bare names are tier 0."""
+    base, _, t = tname.partition("#")
+    return base, int(t) if t else 0
+
+
+def tiered_name(base: str, tier: int) -> str:
+    return base if tier == 0 else f"{base}#{tier}"
+
+
+def scaled_cfg(cfg: AntidoteConfig, tier: int) -> AntidoteConfig:
+    """The config a tier table sizes its slotted state (and slot-scaled
+    effect lanes, e.g. register_mv observed ids) from."""
+    if tier == 0:
+        return cfg
+    import dataclasses
+
+    s = _TIER_SCALE ** tier
+    return dataclasses.replace(
+        cfg,
+        set_slots=cfg.set_slots * s,
+        mv_slots=cfg.mv_slots * s,
+        rga_slots=cfg.rga_slots * s,
+    )
+
 
 def stable_min_of(clock_rows: np.ndarray, use_pallas: bool = False) -> np.ndarray:
     """Entry-wise min over a clock matrix ``i32[N, D]`` — the stable-time
@@ -56,6 +96,17 @@ def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
     hash via the native router — mirroring log_utilities:get_key_partition
     (/root/reference/src/log_utilities.erl:75-79,96-118)."""
     return shard_of(key, bucket, n_shards)
+
+
+def _pad_lane(x, width: int, dtype) -> np.ndarray:
+    """Zero-pad an effect lane to a (wider) tier's width."""
+    x = np.asarray(x, dtype)
+    if x.shape[0] == width:
+        return x
+    assert x.shape[0] < width, (x.shape, width)
+    out = np.zeros((width,), dtype)
+    out[: x.shape[0]] = x
+    return out
 
 
 def effect_from_rec(rec: dict) -> "Effect":
@@ -101,23 +152,44 @@ class KVStore:
         self.applied_vc = np.zeros((cfg.n_shards, cfg.max_dcs), np.int32)
         #: per-type cached bottom (never-written) resolved view
         self._bottom_cache: Dict[str, Dict[str, np.ndarray]] = {}
+        #: keys promoted to a wider slot tier (observability + tests)
+        self.promotions = 0
+        #: type_name -> whether the type has slot accounting (cached so the
+        #: apply_effects demand pre-pass skips unslotted effects cheaply)
+        self._slotted: Dict[str, bool] = {}
+
+    def _is_slotted(self, type_name: str) -> bool:
+        hit = self._slotted.get(type_name)
+        if hit is None:
+            hit = get_type(type_name).slot_capacity(self.cfg) is not None
+            self._slotted[type_name] = hit
+        return hit
 
     # ------------------------------------------------------------------
-    def table(self, type_name: str) -> TypedTable:
-        t = self.tables.get(type_name)
+    def table(self, tname: str) -> TypedTable:
+        """Table for a (possibly tiered) name; tier tables are built with
+        x4-per-tier slot widths and start small (few keys ever promote)."""
+        t = self.tables.get(tname)
         if t is None:
-            t = TypedTable(
-                get_type(type_name), self.cfg, sharding=self.sharding
+            base, tier = split_tier(tname)
+            cfg = scaled_cfg(self.cfg, tier)
+            n_rows = None if tier == 0 else max(
+                self.cfg.keys_per_table // (_TIER_SCALE ** tier), 16
             )
-            self.tables[type_name] = t
+            t = TypedTable(
+                get_type(base), cfg, n_rows=n_rows, sharding=self.sharding
+            )
+            self.tables[tname] = t
         return t
 
     def locate(self, key, type_name: str, bucket: str, create: bool = True):
-        """(type_name, shard, row) for a bound object; allocates on first use."""
+        """(tiered_name, shard, row) for a bound object; allocates on first
+        use.  The first element names the table (base type + slot tier);
+        callers needing the CRDT type use ``split_tier(...)[0]``."""
         dk = (key, bucket)
         hit = self.directory.get(dk)
         if hit is not None:
-            if hit[0] != type_name:
+            if split_tier(hit[0])[0] != type_name:
                 raise TypeError(
                     f"key {key!r} bucket {bucket!r} already bound to {hit[0]}, "
                     f"not {type_name}"
@@ -168,11 +240,42 @@ class KVStore:
         (the batched analogue of clocksi_vnode:update_materializer,
         /root/reference/src/clocksi_vnode.erl:634-657).
         """
-        by_type: Dict[str, list] = {}
-        touched = []
         self.locate_many([(e.key, e.type_name, e.bucket) for e in effects])
+        # ---- overflow escape hatch: promote BEFORE anything can drop.
+        # Aggregate each key's worst-case fresh-slot demand (+ the minimum
+        # tier its effect lanes require — a remote DC may ship wider
+        # lanes); keys whose conservative bound would exceed capacity
+        # migrate to a wider tier now, so the device fold below never hits
+        # a full slot table.
+        demand: Dict[Tuple[Any, str], List[int]] = {}
+        for eff in effects:
+            if not self._is_slotted(eff.type_name):
+                continue  # counters/flags/lww can never overflow
+            ent = self.locate(eff.key, eff.type_name, eff.bucket)
+            base, tier = split_tier(ent[0])
+            ty = get_type(base)
+            d = ty.slot_demand(eff.eff_a, eff.eff_b)
+            need_t = self._tier_for_lanes(ty, len(eff.eff_a), len(eff.eff_b))
+            if d or need_t > tier:
+                cur = demand.setdefault((eff.key, eff.bucket), [0, 0])
+                cur[0] += d
+                cur[1] = max(cur[1], need_t)
+        for dk, (d, need_t) in demand.items():
+            tname_t, shard, row = self.directory[dk]
+            base, tier = split_tier(tname_t)
+            ty = get_type(base)
+            t = self.table(tname_t)
+            cap = ty.slot_capacity(t.cfg)
+            if need_t <= tier and (
+                cap is None or t.slots_ub[shard, row] + d <= cap
+            ):
+                t.slots_ub[shard, row] += d
+                continue
+            self._promote_key(dk, extra_demand=d, min_tier=need_t)
+        by_table: Dict[str, list] = {}
+        touched = []
         for i, eff in enumerate(effects):
-            _, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
+            tname_t, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
             for h, data in eff.blob_refs:
                 self.blobs.intern_bytes(h, data)
             if self.log is not None:
@@ -182,19 +285,21 @@ class KVStore:
                     eff.eff_a, eff.eff_b, commit_vcs[i], origins[i],
                     eff.blob_refs,
                 )
-            by_type.setdefault(eff.type_name, []).append(
+            by_table.setdefault(tname_t, []).append(
                 (shard, row, eff.eff_a, eff.eff_b, commit_vcs[i], origins[i])
             )
             touched.append((shard, np.asarray(commit_vcs[i], np.int32)))
         if self.log is not None and touched:
             self.log.commit_barrier([s for s, _ in touched])
-        for type_name, items in by_type.items():
-            t = self.table(type_name)
+        for tname_t, items in by_table.items():
+            t = self.table(tname_t)
+            aw = t.ty.eff_a_width(t.cfg)
+            bw = t.ty.eff_b_width(t.cfg)
             t.append(
                 np.asarray([x[0] for x in items], np.int64),
                 np.asarray([x[1] for x in items], np.int64),
-                np.stack([np.asarray(x[2], np.int64) for x in items]),
-                np.stack([np.asarray(x[3], np.int32) for x in items]),
+                np.stack([_pad_lane(x[2], aw, np.int64) for x in items]),
+                np.stack([_pad_lane(x[3], bw, np.int32) for x in items]),
                 np.stack([np.asarray(x[4], np.int32) for x in items]),
                 np.asarray([x[5] for x in items], np.int32),
             )
@@ -203,6 +308,120 @@ class KVStore:
         # ops — the causal gate trusts it)
         for shard, vc in touched:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
+
+    # ------------------------------------------------------------------
+    def _tier_for_lanes(self, ty, len_a: int, len_b: int) -> int:
+        """Smallest tier whose effect-lane widths fit the given lanes
+        (register_mv observed-id lanes scale with the origin's tier)."""
+        tier = 0
+        while tier < _MAX_TIER:
+            cfg_t = scaled_cfg(self.cfg, tier)
+            if len_a <= ty.eff_a_width(cfg_t) and len_b <= ty.eff_b_width(cfg_t):
+                return tier
+            tier += 1
+        raise OverflowError(
+            f"{ty.name}: effect lanes ({len_a}, {len_b}) exceed every slot "
+            f"tier up to {_MAX_TIER}"
+        )
+
+    def _promote_key(self, dk, extra_demand: int = 0, min_tier: int = 0) -> None:
+        """Migrate one key to a wider-slot tier table, exactly.
+
+        The whole per-key device state moves — head, snapshot versions,
+        op ring — embedded into the wider layout by zero-padding the
+        widened slot axes (zeros are empty slots in every slotted layout)
+        and the op lanes.  The migration happens BEFORE the batch that
+        would overflow applies, so no op is ever dropped; the reference's
+        unbounded set/map/rga growth is matched tier by tier."""
+        tname_t, shard, row = self.directory[dk]
+        base, tier = split_tier(tname_t)
+        ty = get_type(base)
+        t_old = self.table(tname_t)
+        head_state = {
+            f: np.asarray(x[shard, row]) for f, x in t_old.head.items()
+        }
+        used = ty.used_slots(head_state)
+        cap_cur = ty.slot_capacity(t_old.cfg)
+        if (min_tier <= tier and cap_cur is not None
+                and used + extra_demand <= cap_cur):
+            # the conservative bound went stale (add/remove or re-add
+            # churn): the key actually fits its current tier — re-tighten
+            # the bound in place instead of ratcheting up a tier
+            t_old.slots_ub[shard, row] = used + extra_demand
+            return
+        new_tier = max(tier + 1, min_tier)
+        while True:
+            if new_tier > _MAX_TIER:
+                raise OverflowError(
+                    f"{base} key {dk!r}: {used + extra_demand} slots exceed "
+                    f"the widest tier ({_MAX_TIER})"
+                )
+            cap = ty.slot_capacity(scaled_cfg(self.cfg, new_tier))
+            if cap is None or used + extra_demand <= cap:
+                break
+            new_tier += 1
+        t_new = self.table(tiered_name(base, new_tier))
+        new_row = t_new.alloc_row(shard)
+
+        def embed(src: np.ndarray, dst_shape) -> np.ndarray:
+            out = np.zeros(dst_shape, src.dtype)
+            out[tuple(slice(0, s) for s in src.shape)] = src
+            return out
+
+        for f in t_old.snap:
+            src = np.asarray(t_old.snap[f][shard, row])
+            t_new.snap[f] = t_new.snap[f].at[shard, new_row].set(
+                embed(src, t_new.snap[f].shape[2:])
+            )
+            hsrc = head_state[f]
+            t_new.head[f] = t_new.head[f].at[shard, new_row].set(
+                embed(hsrc, t_new.head[f].shape[2:])
+            )
+        t_new.snap_vc = t_new.snap_vc.at[shard, new_row].set(
+            np.asarray(t_old.snap_vc[shard, row])
+        )
+        # renumber version seqs above everything in the new table so the
+        # per-key newest-version order survives the move
+        seq = np.asarray(t_old.snap_seq[shard, row], np.int64)
+        seq = np.where(seq > 0, seq + t_new.next_seq, 0)
+        t_new.next_seq += int(t_old.next_seq)
+        t_new.snap_seq = t_new.snap_seq.at[shard, new_row].set(seq)
+        t_new.ops_a = t_new.ops_a.at[shard, new_row].set(
+            embed(np.asarray(t_old.ops_a[shard, row]), t_new.ops_a.shape[2:])
+        )
+        t_new.ops_b = t_new.ops_b.at[shard, new_row].set(
+            embed(np.asarray(t_old.ops_b[shard, row]), t_new.ops_b.shape[2:])
+        )
+        t_new.ops_vc = t_new.ops_vc.at[shard, new_row].set(
+            np.asarray(t_old.ops_vc[shard, row])
+        )
+        t_new.ops_origin = t_new.ops_origin.at[shard, new_row].set(
+            np.asarray(t_old.ops_origin[shard, row])
+        )
+        t_new.head_vc = t_new.head_vc.at[shard, new_row].set(
+            np.asarray(t_old.head_vc[shard, row])
+        )
+        t_new.n_ops[shard, new_row] = t_old.n_ops[shard, row]
+        t_new.slots_ub[shard, new_row] = used + extra_demand
+        t_new.max_abs_delta = max(t_new.max_abs_delta, t_old.max_abs_delta)
+        np.maximum(t_new.max_commit_vc, t_old.max_commit_vc,
+                   out=t_new.max_commit_vc)
+        # clear the old row: it stays allocated (orphaned — promotions are
+        # rare) but must never serve stale state
+        for f in t_old.snap:
+            t_old.snap[f] = t_old.snap[f].at[shard, row].set(0)
+            t_old.head[f] = t_old.head[f].at[shard, row].set(0)
+        t_old.snap_vc = t_old.snap_vc.at[shard, row].set(0)
+        t_old.snap_seq = t_old.snap_seq.at[shard, row].set(0)
+        t_old.ops_a = t_old.ops_a.at[shard, row].set(0)
+        t_old.ops_b = t_old.ops_b.at[shard, row].set(0)
+        t_old.ops_vc = t_old.ops_vc.at[shard, row].set(0)
+        t_old.ops_origin = t_old.ops_origin.at[shard, row].set(0)
+        t_old.head_vc = t_old.head_vc.at[shard, row].set(0)
+        t_old.n_ops[shard, row] = 0
+        t_old.slots_ub[shard, row] = 0
+        self.directory[dk] = (tiered_name(base, new_tier), shard, new_row)
+        self.promotions += 1
 
     # ------------------------------------------------------------------
     def read_states(
@@ -224,10 +443,10 @@ class KVStore:
                     for f, (shape, dtype) in ty.state_spec(self.cfg).items()
                 }
                 continue
-            _, shard, row = ent
-            by_type.setdefault(type_name, []).append((i, shard, row))
-        for type_name, items in by_type.items():
-            t = self.table(type_name)
+            tname_t, shard, row = ent
+            by_type.setdefault(tname_t, []).append((i, shard, row))
+        for tname_t, items in by_type.items():
+            t = self.table(tname_t)
             shards = np.asarray([x[1] for x in items], np.int64)
             rows = np.asarray([x[2] for x in items], np.int64)
             vcs = np.broadcast_to(read_vc, (len(items), read_vc.shape[-1]))
@@ -249,9 +468,9 @@ class KVStore:
                     by_shard: Dict[int, list] = {}
                     for j in incomplete:
                         gi = items[j][0]  # global object index
-                        key, tname, bucket = objects[gi]
+                        key, _, bucket = objects[gi]
                         by_shard.setdefault(items[j][1], []).append(
-                            (j, key, tname, bucket)
+                            (j, key, tname_t, bucket)
                         )
                     for shard, wants in by_shard.items():
                         reps = self._replay_read_many(shard, wants, read_vc)
@@ -309,10 +528,10 @@ class KVStore:
             if ent is None:
                 out[i] = self._bottom_resolved(type_name)
                 continue
-            _, shard, row = ent
-            by_type.setdefault(type_name, []).append((i, shard, row))
-        for type_name, items in by_type.items():
-            t = self.table(type_name)
+            tname_t, shard, row = ent
+            by_type.setdefault(tname_t, []).append((i, shard, row))
+        for tname_t, items in by_type.items():
+            t = self.table(tname_t)
             ty = t.ty
             shards = np.asarray([x[1] for x in items], np.int64)
             rows = np.asarray([x[2] for x in items], np.int64)
@@ -326,9 +545,9 @@ class KVStore:
                 by_shard: Dict[int, list] = {}
                 for j in bad:
                     gi = items[j][0]
-                    key, tname, bucket = objects[gi]
+                    key, _, bucket = objects[gi]
                     by_shard.setdefault(items[j][1], []).append(
-                        (int(j), key, tname, bucket)
+                        (int(j), key, tname_t, bucket)
                     )
                 for shard, wants in by_shard.items():
                     reps = self._replay_read_many(shard, wants, read_vc)
@@ -343,7 +562,7 @@ class KVStore:
                         elif ty.resolve_spec(self.cfg) is not None:
                             out[gi] = {
                                 f: np.asarray(x)
-                                for f, x in ty.resolve(self.cfg, rep).items()
+                                for f, x in ty.resolve(t.cfg, rep).items()
                             }
                         else:
                             out[gi] = rep
@@ -363,8 +582,10 @@ class KVStore:
     # ------------------------------------------------------------------
     def _replay_read_many(self, shard: int, wants, read_vc):
         """Rebuild several keys' states at ``read_vc`` from one scan of the
-        shard's durable log.  ``wants`` = [(result_idx, key, type, bucket)].
-        """
+        shard's durable log.  ``wants`` = [(result_idx, key, tiered_name,
+        bucket)] — the state is rebuilt at the key's CURRENT tier width
+        (wide enough for every logged effect, since the live store
+        promoted before any wide effect applied)."""
         if self.log is None:
             raise RuntimeError(
                 f"incomplete read for {[w[1] for w in wants]!r} and no log "
@@ -376,25 +597,33 @@ class KVStore:
         read_vc = np.asarray(read_vc, np.int32)
         states = {}
         index = {}
-        for j, key, tname, bucket in wants:
-            ty = get_type(tname)
-            spec = ty.state_spec(self.cfg)
+        for j, key, tname_t, bucket in wants:
+            base, tier = split_tier(tname_t)
+            ty = get_type(base)
+            cfg_t = scaled_cfg(self.cfg, tier)
+            spec = ty.state_spec(cfg_t)
             states[j] = {
                 f: jnp.zeros(shape, dtype) for f, (shape, dtype) in spec.items()
             }
-            index[(key, bucket)] = (j, ty)
+            index[(key, bucket)] = (j, ty, cfg_t)
         for rec in self.log.replay_shard(shard):
             hit = index.get((freeze_key(rec["k"]), rec["b"]))
             if hit is None:
                 continue
-            j, ty = hit
+            j, ty, cfg_t = hit
             vc = np.asarray(rec["vc"], np.int32)
             if not (vc <= read_vc).all():
                 continue
             states[j] = ty.apply(
-                self.cfg, states[j],
-                jnp.asarray(np.frombuffer(rec["a"], np.int64)),
-                jnp.asarray(np.frombuffer(rec["eb"], np.int32)),
+                cfg_t, states[j],
+                jnp.asarray(_pad_lane(
+                    np.frombuffer(rec["a"], np.int64),
+                    ty.eff_a_width(cfg_t), np.int64,
+                )),
+                jnp.asarray(_pad_lane(
+                    np.frombuffer(rec["eb"], np.int32),
+                    ty.eff_b_width(cfg_t), np.int32,
+                )),
                 jnp.asarray(vc), jnp.int32(rec["o"]),
             )
         return {j: jax.tree.map(np.asarray, s) for j, s in states.items()}

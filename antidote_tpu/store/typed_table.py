@@ -223,6 +223,10 @@ class TypedTable:
         self.ops_vc = mk((p, n, k, d), jnp.int32)
         self.ops_origin = mk((p, n, k), jnp.int32)
         self.n_ops = np.zeros((p, n), np.int32)  # host-authoritative mirror
+        # host-side conservative bound on per-key used element slots —
+        # drives the overflow escape hatch (KVStore._promote_key): only
+        # ever over-counts, reset to the exact count at promotion
+        self.slots_ub = np.zeros((p, n), np.int32)
         # head = eagerly-materialized state at each key's full applied
         # history (folded at append time; reads at VC ≥ head_vc are gathers)
         self.head = {
@@ -260,6 +264,7 @@ class TypedTable:
         self.head = {f: grow(x) for f, x in self.head.items()}
         self.head_vc = grow(self.head_vc)
         self.n_ops = np.pad(self.n_ops, ((0, 0), (0, new_n - self.n_rows)))
+        self.slots_ub = np.pad(self.slots_ub, ((0, 0), (0, new_n - self.n_rows)))
         self.n_rows = new_n
 
     # ------------------------------------------------------------------
@@ -487,10 +492,21 @@ class TypedTable:
             self.gc(uniq[:, 0], uniq[:, 1])
             slots = self.n_ops[shards, rows] + occ
             if (slots >= k).any():
-                raise OverflowError(
-                    f"more than {k} ops for one key in a single batch; "
-                    f"split the batch (type={self.ty.name})"
-                )
+                # a single batch carries more ops for one key than the
+                # ring holds (e.g. one txn add_all of 3x ops_per_key):
+                # split by per-key occurrence so each sub-batch fits, with
+                # a GC fold between them — per-key commit order preserved
+                chunk = occ // k
+                for c in range(int(chunk.max()) + 1):
+                    m = chunk == c
+                    self.append(
+                        shards[m], rows[m],
+                        np.asarray(eff_a, np.int64)[m],
+                        np.asarray(eff_b, np.int32)[m],
+                        np.asarray(vcs, np.int32)[m],
+                        np.asarray(origins, np.int32)[m],
+                    )
+                return
         eff_a = np.asarray(eff_a, np.int64)
         if m and eff_a.shape[1] > 0:
             self.max_abs_delta = max(

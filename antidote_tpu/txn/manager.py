@@ -295,10 +295,17 @@ class TransactionManager:
         guarded_b = type_name == "counter_b" and op[0] in ("decrement",
                                                            "transfer")
         state = None
+        # the key's slot-tier cfg: a promoted key's state (and the effect
+        # lanes its downstream emits, e.g. mv observed ids) has the wider
+        # tier's widths
+        cfg_k = self.cfg
         if ty.require_state_downstream(op) or guarded_b:
             state = self._read_states_with_overlay(
                 [(key, type_name, bucket)], txn
             )[0]
+            ent = self.store.locate(key, type_name, bucket, create=False)
+            if ent is not None:
+                cfg_k = self.store.table(ent[0]).cfg
         # escrow guard: counter_b decrements and outgoing transfers must be
         # covered by locally held rights, and must act on THIS replica's
         # lane — any other lane would spend rights this replica does not
@@ -326,7 +333,7 @@ class TransactionManager:
                 raise AbortError(str(e)) from e
             self.bcounters.satisfied(key, bucket)
         for eff_a, eff_b, blob_refs in ty.downstream(
-            op, state, self.store.blobs, self.cfg
+            op, state, self.store.blobs, cfg_k
         ):
             txn.writeset.append(
                 (Effect(key, type_name, bucket, eff_a, eff_b, blob_refs), op)
@@ -436,18 +443,26 @@ class TransactionManager:
 
         tvc = jnp.asarray(tentative, jnp.int32)
         origin = jnp.int32(self.my_dc)
+        from antidote_tpu.store.kv import _pad_lane
+
         for i, (key, type_name, bucket) in enumerate(objects):
             pend = txn.pending_for(key, bucket)
             if not pend:
                 continue
             ty = get_type(type_name)
+            # overlay at the key's slot-tier widths (promoted keys carry
+            # wider state; pending effect lanes pad up to match)
+            ent = self.store.locate(key, type_name, bucket, create=False)
+            cfg_k = self.store.table(ent[0]).cfg if ent else self.cfg
             state = {f: jnp.asarray(x) for f, x in states[i].items()}
             for eff in pend:
                 state = ty.apply(
-                    self.cfg,
+                    cfg_k,
                     state,
-                    jnp.asarray(eff.eff_a, jnp.int64),
-                    jnp.asarray(eff.eff_b, jnp.int32),
+                    jnp.asarray(_pad_lane(
+                        eff.eff_a, ty.eff_a_width(cfg_k), np.int64)),
+                    jnp.asarray(_pad_lane(
+                        eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
                     tvc,
                     origin,
                 )

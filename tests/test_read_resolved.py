@@ -296,15 +296,21 @@ def test_client_reads_use_fused_serving_path(monkeypatch):
     assert calls["resolved"] == 0 and calls["states"] >= 1
 
 
-def test_resolved_view_carries_overflow_warning():
-    """r3 review: the serving path must preserve the slot-exhaustion
-    warning the full-state value() path emits — the resolved view ships
-    the ovf counter."""
+def test_resolved_view_ships_ovf_and_hatch_prevents_drops():
+    """The resolved view carries the ovf counter (so TypedTable-direct
+    deployments keep the slot-exhaustion warning on the serving path —
+    see test_typed_table.py::test_set_slot_overflow_warns), while the
+    KVStore-level escape hatch makes the node path drop-free: 3 adds into
+    a 2-slot set promote the key instead of truncating."""
     import warnings
 
     from antidote_tpu.api.node import AntidoteNode
 
-    node = AntidoteNode(_mk_cfg(set_slots=2))
+    cfg = _mk_cfg(set_slots=2)
+    ty = get_type("set_aw")
+    assert "ovf" in ty.resolve_spec(cfg)
+
+    node = AntidoteNode(cfg)
     node.update_objects([
         ("k", "set_aw", "b", ("add_all", ["a", "b", "c"])),  # 3 > 2 slots
         ("k", "set_aw", "b", ("remove", "a")),
@@ -312,5 +318,6 @@ def test_resolved_view_carries_overflow_warning():
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
         vals, _ = node.read_objects([("k", "set_aw", "b")])
-    assert any("dropped" in str(w.message) for w in rec), \
-        "serving path lost the overflow warning"
+    assert sorted(vals[0]) == ["b", "c"]  # nothing dropped
+    assert not any("dropped" in str(w.message) for w in rec)
+    assert node.store.promotions >= 1
