@@ -196,6 +196,8 @@ class TcpFabric:
     def __init__(self, host: str = "127.0.0.1"):
         self.host = host
         self.endpoints: Dict[int, _Endpoint] = {}
+        #: dc_id -> tick callback (deferred-heartbeat flush at pump)
+        self._ticks: Dict[int, Callable] = {}
         #: dc_id -> (host, port) for remote DCs
         self.addresses: Dict[int, Tuple[str, int]] = {}
         #: subscriber-side inbox: (on_message, data) pairs await pump()
@@ -276,15 +278,32 @@ class TcpFabric:
         return self._rpc(target_dc, K_REQ,
                          {"kind": kind, "payload": payload})
 
+    def register_tick(self, dc_id: int, fn) -> None:
+        """Tick callback run at each pump — replicas flush deferred
+        heartbeats here (see LoopbackHub.register_tick)."""
+        self._ticks[dc_id] = fn
+
     def pump(self, max_rounds: int = 100_000, timeout: float = 0.5) -> int:
         """Deliver queued stream messages on the calling thread until the
-        fabric is quiescent for ``timeout`` seconds."""
+        fabric is quiescent for ``timeout`` seconds.
+
+        Ticks (deferred-heartbeat flushes) re-run whenever the inbox goes
+        idle, mirroring LoopbackHub.pump: a commit made by a server thread
+        MID-pump (e.g. a bcounter grant) still flushes its safe time
+        before this pump returns."""
         n = 0
+        for fn in list(self._ticks.values()):
+            fn()
         while n < max_rounds:
             try:
                 cb, data = self.inbox.get(timeout=timeout)
             except queue.Empty:
-                return n
+                for fn in list(self._ticks.values()):
+                    fn()
+                try:
+                    cb, data = self.inbox.get_nowait()
+                except queue.Empty:
+                    return n
             # take the local handler locks so server threads (queries,
             # bcounter grants) never interleave with gate processing
             with self._local_locks():

@@ -30,6 +30,11 @@ class LoopbackHub:
         self.query_handlers: Dict[int, Callable] = {}
         #: dc_id -> generic request handler (kind, payload) -> reply
         self.request_handlers: Dict[int, Callable] = {}
+        #: dc_id -> tick callback, run at every pump — replicas flush
+        #: deferred heartbeats here (the in-process stand-in for the
+        #: reference's 1 s timer,
+        #: /root/reference/src/inter_dc_log_sender_vnode.erl:188-204)
+        self.ticks: Dict[int, Callable[[], None]] = {}
         self.queues: collections.deque = collections.deque()
         #: (from_dc, to_dc) pairs whose next N messages are dropped
         self.drop: Dict[Tuple[int, int], int] = {}
@@ -48,6 +53,7 @@ class LoopbackHub:
         one appends to)."""
         self.query_handlers.pop(dc_id, None)
         self.request_handlers.pop(dc_id, None)
+        self.ticks.pop(dc_id, None)
         self.subscribers.pop(dc_id, None)
         for pub, subs in self.subscribers.items():
             self.subscribers[pub] = [
@@ -57,6 +63,9 @@ class LoopbackHub:
             (to_dc, cb, data) for to_dc, cb, data in self.queues
             if to_dc != dc_id
         )
+
+    def register_tick(self, dc_id: int, fn: Callable[[], None]) -> None:
+        self.ticks[dc_id] = fn
 
     def register_request(self, dc_id: int, handler: Callable) -> None:
         """Attach a generic request handler ((kind, payload) -> reply) —
@@ -96,11 +105,19 @@ class LoopbackHub:
         self.drop[(from_dc, to_dc)] = self.drop.get((from_dc, to_dc), 0) + n
 
     def pump(self, max_rounds: int = 10_000) -> int:
-        """Deliver queued messages until quiescent; returns count."""
+        """Deliver queued messages until quiescent; returns count.
+
+        Ticks run before each drain round so deferred heartbeats flush
+        (and their deliveries may unblock causal gates in the same pump)."""
         n = 0
-        while self.queues and n < max_rounds:
-            _, cb, data = self.queues.popleft()
-            cb(data)
-            self.delivered += 1
-            n += 1
+        while n < max_rounds:
+            for fn in list(self.ticks.values()):
+                fn()
+            if not self.queues:
+                break
+            while self.queues and n < max_rounds:
+                _, cb, data = self.queues.popleft()
+                cb(data)
+                self.delivered += 1
+                n += 1
         return n

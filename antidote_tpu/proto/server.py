@@ -76,6 +76,19 @@ class ProtocolServer:
     def _make_handler(server_self):
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # txns this connection started and has not finished: a
+                # dropped connection must not pin open transactions (they
+                # hold the certification-GC floor — manager._open_snaps —
+                # forever; the reference's coordinator FSMs die with the
+                # client process and roll back the same way)
+                conn_txns = set()
+                try:
+                    self._serve(conn_txns)
+                finally:
+                    for txid in conn_txns:
+                        server_self._abort_orphan(txid)
+
+            def _serve(self, conn_txns):
                 while True:
                     try:
                         frame = read_frame(self.request)
@@ -84,7 +97,14 @@ class ProtocolServer:
                     try:
                         code, body = decode(frame)
                         resp_code, resp = server_self._process(code, body)
+                        if code == MessageCode.START_TRANSACTION:
+                            conn_txns.add(resp["txid"])
+                        elif code in (MessageCode.COMMIT_TRANSACTION,
+                                      MessageCode.ABORT_TRANSACTION):
+                            conn_txns.discard(body.get("txid"))
                     except AbortError as e:
+                        if code == MessageCode.UPDATE_OBJECTS:
+                            conn_txns.discard(body.get("txid"))
                         resp_code, resp = MessageCode.ERROR_RESP, {
                             "error": "aborted", "detail": str(e)
                         }
@@ -99,6 +119,13 @@ class ProtocolServer:
                         return
 
         return Handler
+
+    def _abort_orphan(self, txid: int) -> None:
+        """Roll back a transaction whose client connection died."""
+        with self._lock:
+            txn = self._txns.pop(txid, None)
+            if txn is not None and txn.active:
+                self.node.abort_transaction(txn)
 
     # ------------------------------------------------------------------
     def _process(self, code: MessageCode, body: Any):

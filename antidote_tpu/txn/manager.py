@@ -77,8 +77,16 @@ class TransactionManager:
         assert protocol in ("clocksi", "gr"), protocol
         self.protocol = protocol
         self.commit_counter = 0
-        #: (key, bucket) -> my-lane counter of its last local commit
+        #: (key, bucket) -> my-lane counter of its last local commit.
+        #: Bounded: entries at or below every open txn's snapshot can
+        #: never conflict again and are GC'd periodically (the reference
+        #: prunes its committed_tx ETS against the stable time the same
+        #: way, /root/reference/src/clocksi_vnode.erl:671-678)
         self.committed_keys: Dict[Tuple[Any, str], int] = {}
+        #: open txid -> its own-lane snapshot (the GC floor)
+        self._open_snaps: Dict[int, int] = {}
+        self._cert_gc_every = 1024
+        self._next_cert_gc = self._cert_gc_every
         self.hooks = HookRegistry()
         #: escrow guard for counter_b (bcounter_mgr, SURVEY §2.5)
         self.bcounters = BCounterManager(my_dc)
@@ -135,7 +143,9 @@ class TransactionManager:
             snap = np.maximum(snap, clock)
         if self.metrics is not None:
             self.metrics.open_transactions.inc()
-        return Transaction(snap, props)
+        txn = Transaction(snap, props)
+        self._open_snaps[txn.txid] = int(snap[self.my_dc])
+        return txn
 
     def read_objects(self, objects: Sequence[BoundObject], txn: Transaction,
                      _internal: bool = False):
@@ -342,6 +352,7 @@ class TransactionManager:
     def commit_transaction(self, txn: Transaction) -> np.ndarray:
         assert txn.active
         txn.active = False
+        self._open_snaps.pop(txn.txid, None)
         if self.metrics is not None:
             self.metrics.open_transactions.dec()
         if not txn.writeset:
@@ -373,6 +384,9 @@ class TransactionManager:
         )
         for eff, _ in txn.writeset:
             self.committed_keys[(eff.key, eff.bucket)] = self.commit_counter
+        if self.commit_counter >= self._next_cert_gc:
+            self._gc_committed_keys()
+            self._next_cert_gc = self.commit_counter + self._cert_gc_every
         for listener in self.commit_listeners:
             listener(effects, commit_vc, self.my_dc)
         for eff, op in txn.writeset:
@@ -381,8 +395,35 @@ class TransactionManager:
             )
         return commit_vc
 
+    def _gc_committed_keys(self) -> None:
+        """Drop certification entries no open (or future) txn can conflict
+        with: cert aborts iff last_commit > snapshot, every open txn's
+        own-lane snapshot is ≥ the floor, and future txns start at the
+        current counter — so entries ≤ floor are dead weight."""
+        floor = min(self._open_snaps.values(), default=self.commit_counter)
+        if self.commit_counter - floor > 64 * self._cert_gc_every:
+            # an ancient open transaction (leaked coordinator?) is pinning
+            # the floor — the certification table cannot shrink past it.
+            # Server-side connection cleanup aborts orphans; surface the
+            # stragglers loudly rather than silently growing.
+            import warnings
+
+            warnings.warn(
+                f"certification GC floor lags {self.commit_counter - floor} "
+                f"commits behind: {len(self._open_snaps)} transaction(s) "
+                "left open",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        if floor <= 0:
+            return
+        self.committed_keys = {
+            k: v for k, v in self.committed_keys.items() if v > floor
+        }
+
     def _mark_aborted(self, txn: Transaction) -> None:
         """Close an active txn as aborted, keeping the gauge/counter exact."""
+        self._open_snaps.pop(txn.txid, None)
         if txn.active and self.metrics is not None:
             self.metrics.open_transactions.dec()
             self.metrics.aborted_transactions.inc()

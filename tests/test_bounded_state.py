@@ -1,0 +1,191 @@
+"""Bounded long-run state + WAL-backed catch-up (r2 VERDICT item 4).
+
+Covers: catch-up served from the durable log once the in-memory window
+has rolled past the requested opid; O(touched-shards) fabric messages
+per commit (heartbeats timer/threshold/pump-driven, not per-commit);
+committed_keys certification-table GC below every open snapshot; and
+restore_from_log grouping txns by (origin, vc) identity rather than
+record adjacency (r1 advisor medium (c)).
+"""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.interdc.replica import DCReplica
+from antidote_tpu.interdc.transport import LoopbackHub
+
+
+def _cfg(**kw):
+    base = dict(n_shards=4, max_dcs=3, ops_per_key=8, keys_per_table=64,
+                batch_buckets=(16, 64))
+    base.update(kw)
+    return AntidoteConfig(**base)
+
+
+def _mk_dc(dc_id, hub, tmp_path=None):
+    cfg = _cfg()
+    log_dir = str(tmp_path / f"dc{dc_id}") if tmp_path is not None else None
+    node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir)
+    return DCReplica(node, hub)
+
+
+def test_catch_up_below_window_served_from_wal(tmp_path, monkeypatch):
+    """Drop a txn, roll the in-memory window fully past it, and verify the
+    gap still heals — the catch-up query regroups the chain from the WAL."""
+    monkeypatch.setattr(DCReplica, "SENT_WINDOW", 4)
+    hub = LoopbackHub()
+    r0 = _mk_dc(0, hub, tmp_path)
+    r1 = _mk_dc(1, hub, tmp_path)
+    DCReplica.connect_all([r0, r1])
+
+    r0.node.update_objects([("k0", "counter_pn", "b", ("increment", 1))])
+    hub.pump()
+    # lose the next message to DC1, then commit enough to roll the window
+    # (same shard key) far past the lost opid
+    hub.drop_next(0, 1, n=1)
+    for i in range(10):
+        r0.node.update_objects([("k0", "counter_pn", "b", ("increment", 1))])
+        hub.pump()
+    assert len(r0.sent[r0.node.store.locate("k0", "counter_pn", "b")[1]]) == 4
+    r0.heartbeat()
+    hub.pump()
+    vals, _ = r1.node.read_objects([("k0", "counter_pn", "b")])
+    assert vals[0] == 11
+
+
+def test_no_wal_below_window_raises(monkeypatch):
+    monkeypatch.setattr(DCReplica, "SENT_WINDOW", 2)
+    hub = LoopbackHub()
+    r0 = _mk_dc(0, hub)
+    shard = None
+    for i in range(6):
+        r0.node.update_objects([("k0", "counter_pn", "b", ("increment", 1))])
+        shard = r0.node.store.locate("k0", "counter_pn", "b")[1]
+    with pytest.raises(RuntimeError, match="below the in-memory window"):
+        r0._serve_log_query(shard, 0, 0)
+
+
+def test_commit_publishes_only_touched_shards():
+    """r2 VERDICT weak #5: a commit publishes one message per TOUCHED
+    shard; idle-shard safe times flush once per pump, not per commit."""
+    hub = LoopbackHub()
+    r0 = _mk_dc(0, hub)
+    r1 = _mk_dc(1, hub)
+    DCReplica.connect_all([r0, r1])
+    published = []
+    orig = hub.publish
+    hub.publish = lambda f, d: (published.append(f), orig(f, d))
+
+    n_commits = 5
+    for i in range(n_commits):
+        r0.node.update_objects([(f"k{i}", "counter_pn", "b",
+                                 ("increment", 1))])
+    # 5 commits, each touching one shard -> exactly 5 txn messages so far
+    # (no per-commit heartbeat fan-out)
+    assert len(published) == n_commits
+    hub.pump()  # tick flushes ONE heartbeat round (n_shards pings)
+    assert len(published) == n_commits + r0.node.cfg.n_shards
+    hub.pump()  # quiescent: no commits since flush -> no more pings
+    assert len(published) == n_commits + r0.node.cfg.n_shards
+    # remote still converges
+    vals, _ = r1.node.read_objects([("k0", "counter_pn", "b")])
+    assert vals[0] == 1
+
+
+def test_committed_keys_gc_bounded():
+    node = AntidoteNode(_cfg(keys_per_table=8192))
+    txm = node.txm
+    txm._cert_gc_every = 256
+    txm._next_cert_gc = 256
+    for i in range(1000):
+        node.update_objects([(f"k{i}", "counter_pn", "b", ("increment", 1))])
+    # GC fired at least thrice; all but the entries since the last floor
+    # advance are gone
+    assert len(txm.committed_keys) <= 2 * txm._cert_gc_every
+    # correctness: first-committer-wins still aborts on a real conflict
+    t1 = node.start_transaction()
+    node.update_objects([("kX", "counter_pn", "b", ("increment", 1))], t1)
+    node.update_objects([("kX", "counter_pn", "b", ("increment", 1))])
+    from antidote_tpu.txn.manager import AbortError
+    with pytest.raises(AbortError):
+        node.commit_transaction(t1)
+    # an open txn pins the floor: entries above its snapshot survive GC
+    t2 = node.start_transaction()
+    for i in range(600):
+        node.update_objects([(f"pin{i}", "counter_pn", "b",
+                              ("increment", 1))])
+    assert any(
+        v > txm._open_snaps[t2.txid] for v in txm.committed_keys.values()
+    )
+    node.commit_transaction(t2)
+
+
+def test_restore_groups_txns_by_identity_not_adjacency(tmp_path):
+    """r1 advisor medium (c): a multi-shard txn whose WAL records get
+    re-chained non-adjacently (handoff/reshard replay order) must count as
+    ONE chain opid after restore."""
+    cfg = _cfg(n_shards=2)
+    node = AntidoteNode(cfg, log_dir=str(tmp_path / "src"))
+    # txn T writes two keys on DIFFERENT shards; a later txn writes one
+    ka, kb = 0, 1  # int keys: shard = key % n_shards
+    node.update_objects([
+        (ka, "counter_pn", "b", ("increment", 1)),
+        (kb, "counter_pn", "b", ("increment", 2)),
+    ])
+    node.update_objects([(ka, "counter_pn", "b", ("increment", 3))])
+    node.store.log.close()
+
+    # reshard to ONE shard: both old shards' chains re-log into shard 0,
+    # so T's two records are separated by replay order
+    from antidote_tpu.log import LogManager
+    from antidote_tpu.store import handoff
+    from antidote_tpu.store.kv import KVStore
+
+    src_log = LogManager(cfg, str(tmp_path / "src"))
+    src = KVStore(cfg, log=src_log)
+    src.recover()
+    import dataclasses
+    cfg1 = dataclasses.replace(cfg, n_shards=1)
+    new_log = LogManager(cfg1, str(tmp_path / "dst"))
+    dst = handoff.reshard(src, cfg1, log=new_log)
+
+    node2 = AntidoteNode(cfg1, store=dst)
+    hub = LoopbackHub()
+    r2 = DCReplica(node2, hub)
+    r2.restore_from_log()
+    # 2 transactions total -> chain opid exactly 2 (adjacency grouping
+    # would have split T into two groups iff its records interleaved; with
+    # identity grouping the count is exact either way)
+    assert int(r2.pub_opid[0]) == 2
+    groups = r2._wal_txn_groups(0)
+    assert len(groups) == 2
+    assert sorted(len(g[2]) for g in groups) == [1, 2]
+
+
+def test_proto_server_aborts_orphaned_txns():
+    """A client connection that dies mid-transaction must not pin the
+    certification-GC floor forever (r3 review)."""
+    import socket
+    import time as _time
+
+    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.server import ProtocolServer
+
+    node = AntidoteNode(_cfg())
+    srv = ProtocolServer(node, port=0)
+    try:
+        c = AntidoteClient("127.0.0.1", srv.port)
+        txn = c.start_transaction()
+        txn.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+        assert node.txm._open_snaps  # open txn tracked
+        c.close()
+        for _ in range(100):
+            if not node.txm._open_snaps:
+                break
+            _time.sleep(0.05)
+        assert not node.txm._open_snaps, "orphaned txn not aborted"
+        assert not srv._txns
+    finally:
+        srv.close()

@@ -61,7 +61,9 @@ def test_causal_gate_and_ping_revealed_gap(dcs):
     # writes y (dependent). DC2 must not expose a snapshot claiming x until
     # a later DC0 ping reveals the gap and catch-up fills it.
     hub, nodes, reps = dcs
-    hub.drop_next(0, 2, n=1)  # lose the txn message (heartbeats follow it)
+    # lose the txn message AND the deferred heartbeat flush the next pump
+    # emits (whose chain head would reveal the gap immediately)
+    hub.drop_next(0, 2, n=1 + nodes[0].cfg.n_shards)
     vc0 = nodes[0].update_objects([("x", "counter_pn", "b", ("increment", 1))])
     hub.pump()
     vc1 = nodes[1].read_objects([("x", "counter_pn", "b")], clock=vc0)[1]
