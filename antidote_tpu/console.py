@@ -34,6 +34,26 @@ def _parse_arg(raw: str):
         return raw
 
 
+def resolve_serve_shape(log_dir, shards, max_dcs):
+    """Deployment shape for ``serve``: an explicit flag wins; otherwise an
+    existing log dir's recorded {n_shards, max_dcs}; otherwise the
+    defaults (16, 8).  An explicit flag CONFLICTING with the recorded
+    shape is passed through — LogManager fails loudly on it rather than
+    silently stranding committed shards."""
+    import os
+
+    if log_dir is not None and (shards is None or max_dcs is None):
+        from antidote_tpu.log import load_dir_meta
+
+        meta = load_dir_meta(log_dir) if os.path.isdir(log_dir) else None
+        if meta is not None:
+            if shards is None:
+                shards = meta["n_shards"]
+            if max_dcs is None:
+                max_dcs = meta["max_dcs"]
+    return shards or 16, max_dcs or 8
+
+
 def cmd_serve(args) -> int:
     import os
 
@@ -50,7 +70,9 @@ def cmd_serve(args) -> int:
     from antidote_tpu.config import AntidoteConfig
     from antidote_tpu.proto.server import ProtocolServer
 
-    cfg = AntidoteConfig(n_shards=args.shards, max_dcs=args.max_dcs)
+    shards, max_dcs = resolve_serve_shape(args.log_dir, args.shards,
+                                          args.max_dcs)
+    cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs)
     has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and any(
         f.endswith(".wal") and os.path.getsize(os.path.join(args.log_dir, f)) > 0
         for f in os.listdir(args.log_dir)
@@ -153,8 +175,10 @@ def main(argv=None) -> int:
     sv.add_argument("--port", type=int, default=8087)
     sv.add_argument("--metrics-port", type=int, default=None)
     sv.add_argument("--dc-id", type=int, default=0)
-    sv.add_argument("--shards", type=int, default=16)
-    sv.add_argument("--max-dcs", type=int, default=8)
+    sv.add_argument("--shards", type=int, default=None,
+                    help="default: the log dir's recorded shape, else 16")
+    sv.add_argument("--max-dcs", type=int, default=None,
+                    help="default: the log dir's recorded shape, else 8")
     sv.add_argument("--recover", action="store_true")
     sv.set_defaults(fn=cmd_serve)
 

@@ -164,6 +164,18 @@ class DCReplica:
                     self.last_seen[(origin, shard)] = n
 
     # ------------------------------------------------------------------
+    def ingress_barrier(self):
+        """A lock excluding fabric-thread mutations (TCP request handlers
+        committing bcounter grants) for the duration of a reshard — the
+        stand-in for riak_core blocking vnode commands during ownership
+        handoff.  The single-threaded LoopbackHub needs no lock."""
+        eps = getattr(self.hub, "endpoints", None)
+        if eps and self.dc_id in eps:
+            return eps[self.dc_id].lock
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def descriptor(self) -> Descriptor:
         return Descriptor(self.dc_id, self.name, self.node.cfg.n_shards)
 

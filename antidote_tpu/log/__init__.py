@@ -12,7 +12,9 @@ shard's log to rebuild tables, clocks and op-id counters
 
 from __future__ import annotations
 
+import json
 import os
+import re
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -22,6 +24,85 @@ from antidote_tpu.log.wal import ShardWAL, replay
 
 __all__ = ["LogManager", "ShardWAL", "replay"]
 
+_META_FILE = "antidote_meta.json"
+
+
+class LogDirMismatch(RuntimeError):
+    """The log directory was written under a different deployment shape."""
+
+
+def load_dir_meta(directory: str) -> Optional[dict]:
+    """The {n_shards, max_dcs} a log directory was created with, or None
+    for a fresh/legacy directory."""
+    path = os.path.join(directory, _META_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise LogDirMismatch(
+            f"log dir metadata {path!r} is unreadable ({e}); if a crash "
+            "truncated it, restore it as "
+            '{"n_shards": N, "max_dcs": D, "version": 1} matching the '
+            "directory's original deployment shape"
+        ) from e
+
+
+def _validate_dir(cfg: AntidoteConfig, directory: str) -> None:
+    """First boot stamps the deployment shape into the log directory;
+    every later boot validates it.  Booting a WAL directory with a
+    different shard count would silently strand or mis-route committed
+    data, and a different max_dcs would mis-lane every recovered clock —
+    the riak_core ring metadata persisted next to the data guards the
+    reference against the same operator error (r1 advisor medium (a))."""
+    meta = load_dir_meta(directory)
+    if meta is not None:
+        if (meta["n_shards"] != cfg.n_shards
+                or meta["max_dcs"] != cfg.max_dcs):
+            raise LogDirMismatch(
+                f"log dir {directory!r} was created with n_shards="
+                f"{meta['n_shards']}, max_dcs={meta['max_dcs']}; booting "
+                f"with n_shards={cfg.n_shards}, max_dcs={cfg.max_dcs} "
+                "would lose or corrupt committed data.  Use the recorded "
+                "shape (or reshard via store.handoff.reshard into a new "
+                "directory)."
+            )
+        return
+    # legacy dir (pre-metadata build): shard files are created eagerly, so
+    # their count IS the shape it was written with — any mismatch (shrink
+    # OR grow) mis-routes recovered keys; a max_dcs mismatch is visible in
+    # the clock width of any logged record
+    shard_files = {
+        int(m.group(1))
+        for f in os.listdir(directory)
+        if (m := re.fullmatch(r"shard_(\d+)\.wal", f))
+    }
+    if shard_files and shard_files != set(range(cfg.n_shards)):
+        raise LogDirMismatch(
+            f"legacy log dir {directory!r} holds shard files "
+            f"{sorted(shard_files)} — written with n_shards="
+            f"{len(shard_files)}, not {cfg.n_shards}"
+        )
+    for p in sorted(shard_files):
+        for rec in replay(os.path.join(directory, f"shard_{p}.wal")):
+            if len(rec["vc"]) != cfg.max_dcs:
+                raise LogDirMismatch(
+                    f"legacy log dir {directory!r} records carry "
+                    f"{len(rec['vc'])}-lane clocks — written with "
+                    f"max_dcs={len(rec['vc'])}, not {cfg.max_dcs}"
+                )
+            break  # one record per shard suffices
+    # adopt: stamp the shape atomically (a crash mid-write must not leave
+    # a truncated file that poisons every later boot)
+    tmp = os.path.join(directory, _META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"n_shards": cfg.n_shards, "max_dcs": cfg.max_dcs,
+                   "version": 1}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, _META_FILE))
+
 
 class LogManager:
     def __init__(self, cfg: AntidoteConfig, directory: str,
@@ -29,6 +110,7 @@ class LogManager:
         self.cfg = cfg
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
+        _validate_dir(cfg, directory)
         sync = cfg.sync_log if sync_on_commit is None else sync_on_commit
         self.wals = [
             ShardWAL(os.path.join(directory, f"shard_{p}.wal"),

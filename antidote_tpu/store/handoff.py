@@ -21,6 +21,7 @@ moves with one gather + one scatter per array — no per-key work.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, List, Optional
 
 import msgpack
@@ -255,18 +256,74 @@ def unpack(data: bytes) -> Dict[str, Any]:
     return dec(msgpack.unpackb(data, raw=False, strict_map_key=False))
 
 
-def reshard(store: KVStore, new_cfg, log=None) -> KVStore:
+def assert_replication_quiescent(store: KVStore, my_dc: int,
+                                 replica=None) -> None:
+    """Refuse to reshard a replica with replication in flight.
+
+    Re-chaining splits per-(origin, shard) opid chains; a remote txn
+    buffered/gated mid-flight when the chains renumber would be silently
+    dropped as a duplicate afterwards (r1 advisor medium (b)).  Quiescence
+    here means: no gated or pending remote txns (``replica``), and every
+    remote origin's lane equal across all shard clocks — an unequal lane
+    is a remote commit some shards have applied and others haven't (or a
+    safe-time ping still in the fabric)."""
+    if replica is not None:
+        stuck = [
+            k for k, q in replica.gate.items() if len(q) > 0
+        ] + [
+            k for k, buf in replica.pending.items() if len(buf) > 0
+        ]
+        if stuck:
+            raise RuntimeError(
+                f"reshard with replication in flight: gated/pending remote "
+                f"txns on (origin, shard) chains {sorted(set(stuck))}; "
+                "pump the fabric to quiescence first"
+            )
+    vc = store.applied_vc
+    for lane in range(store.cfg.max_dcs):
+        if lane == my_dc:
+            continue  # local lane legitimately differs per shard
+        if not (vc[:, lane] == vc[0, lane]).all():
+            raise RuntimeError(
+                f"reshard with replication in flight: origin lane {lane} "
+                f"differs across shards ({vc[:, lane].tolist()}); drain "
+                "replication (pump + heartbeats) to quiescence first"
+            )
+
+
+def reshard(store: KVStore, new_cfg, log=None, my_dc: int | None = None,
+            replica=None) -> KVStore:
     """Rebuild a replica onto a different shard count (ring resize).
 
     ``new_cfg`` must differ from ``store.cfg`` only in ``n_shards``.  Every
     key re-routes via one ``shard_batch`` crossing; each table moves with
     one host gather + one device scatter per array.  Returns the new store
     (the old one is left untouched).
+
+    CALLER CONTRACT: replication must be quiescent — pass ``replica``
+    (and/or ``my_dc``) to have that asserted
+    (:func:`assert_replication_quiescent`) and to hold the replica's
+    ingress barrier for the duration: the reference takes the whole ring
+    through riak_core ownership handoff, which blocks vnode commands —
+    without the barrier a remote txn delivered on a fabric thread
+    mid-copy would land in the old store and be silently lost.
     """
     old_cfg = store.cfg
     assert new_cfg.max_dcs == old_cfg.max_dcs
     assert new_cfg.ops_per_key == old_cfg.ops_per_key
     assert new_cfg.snap_versions == old_cfg.snap_versions
+    if replica is not None and my_dc is None:
+        my_dc = replica.dc_id
+    barrier = (replica.ingress_barrier() if replica is not None
+               else contextlib.nullcontext())
+    with barrier:
+        if my_dc is not None:
+            assert_replication_quiescent(store, my_dc, replica)
+        return _reshard_locked(store, new_cfg, log)
+
+
+def _reshard_locked(store: KVStore, new_cfg, log) -> KVStore:
+    old_cfg = store.cfg
     new = KVStore(new_cfg, log=log)
 
     items = list(store.directory.items())

@@ -189,3 +189,91 @@ def test_proto_server_aborts_orphaned_txns():
         assert not srv._txns
     finally:
         srv.close()
+
+
+def test_log_dir_shape_persisted_and_validated(tmp_path):
+    """r1 advisor medium (a): booting a WAL dir under a different
+    {n_shards, max_dcs} fails loudly instead of silently stranding
+    committed shards / mis-laning clocks."""
+    import dataclasses
+
+    from antidote_tpu.log import LogDirMismatch, LogManager, load_dir_meta
+
+    cfg = _cfg()
+    d = str(tmp_path / "wal")
+    node = AntidoteNode(cfg, log_dir=d)
+    node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    node.store.log.close()
+    assert load_dir_meta(d) == {"n_shards": cfg.n_shards,
+                                "max_dcs": cfg.max_dcs, "version": 1}
+    with pytest.raises(LogDirMismatch, match="n_shards"):
+        LogManager(dataclasses.replace(cfg, n_shards=cfg.n_shards // 2), d)
+    with pytest.raises(LogDirMismatch, match="max_dcs"):
+        LogManager(dataclasses.replace(cfg, max_dcs=cfg.max_dcs + 1), d)
+    # the recorded shape reopens fine
+    LogManager(cfg, d).close()
+    # legacy dir (no meta): both shrink AND grow refuse — the eager
+    # shard-file count IS the written shape
+    import os
+    legacy = str(tmp_path / "legacy")
+    os.makedirs(legacy)
+    for i in range(4):
+        open(os.path.join(legacy, f"shard_{i}.wal"), "wb").close()
+    with pytest.raises(LogDirMismatch, match="written with n_shards=4"):
+        LogManager(dataclasses.replace(cfg, n_shards=2), legacy)
+    with pytest.raises(LogDirMismatch, match="written with n_shards=4"):
+        LogManager(dataclasses.replace(cfg, n_shards=8), legacy)
+    # a truncated meta file fails actionably, naming the path
+    broken = str(tmp_path / "broken")
+    os.makedirs(broken)
+    open(os.path.join(broken, "antidote_meta.json"), "w").close()
+    with pytest.raises(LogDirMismatch, match="unreadable"):
+        LogManager(cfg, broken)
+
+
+def test_console_serve_defaults_shape_from_log_dir(tmp_path):
+    """cmd_serve's shape resolution: explicit flag > recorded dir shape >
+    defaults (r3 review: drive the real console logic, not just stamping)."""
+    from antidote_tpu.console import resolve_serve_shape
+    from antidote_tpu.log import LogManager
+
+    cfg = _cfg(n_shards=2)
+    d = str(tmp_path / "wal")
+    LogManager(cfg, d).close()
+    # recorded shape wins over defaults
+    assert resolve_serve_shape(d, None, None) == (2, cfg.max_dcs)
+    # explicit flag wins over the recorded shape (LogManager then refuses)
+    assert resolve_serve_shape(d, 8, None) == (8, cfg.max_dcs)
+    # no dir: defaults
+    assert resolve_serve_shape(None, None, None) == (16, 8)
+    assert resolve_serve_shape(str(tmp_path / "missing"), None, 3) == (16, 3)
+
+
+def test_reshard_refuses_inflight_replication(tmp_path):
+    """r1 advisor medium (b): reshard must assert replication quiescence
+    — gated/pending remote txns or unequal remote lanes refuse."""
+    import dataclasses
+
+    from antidote_tpu.store import handoff
+
+    hub = LoopbackHub()
+    r0 = _mk_dc(0, hub, tmp_path)
+    r1 = _mk_dc(1, hub, tmp_path)
+    DCReplica.connect_all([r0, r1])
+    r0.node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    # NOT pumped: r1 has nothing yet; r0's lanes are its own -> r0 itself
+    # is quiescent, but after partial delivery r1 is not
+    hub.pump()
+    r0.node.update_objects([("k2", "counter_pn", "b", ("increment", 1))])
+    # deliver the txn but NOT the heartbeat flush: lane 0 unequal across
+    # r1's shards
+    while hub.queues:
+        to_dc, cb, data = hub.queues.popleft()
+        cb(data)
+    cfg1 = dataclasses.replace(r1.node.cfg, n_shards=2)
+    with pytest.raises(RuntimeError, match="origin lane 0 differs"):
+        handoff.reshard(r1.node.store, cfg1, my_dc=1, replica=r1)
+    # after full pump + heartbeat the lanes equalize and reshard proceeds
+    r0.heartbeat()
+    hub.pump()
+    handoff.reshard(r1.node.store, cfg1, my_dc=1, replica=r1)
