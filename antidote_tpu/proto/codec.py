@@ -93,6 +93,12 @@ def write_message(sock: socket.socket, code: MessageCode, body: Any) -> None:
     sock.sendall(encode(code, body))
 
 
+def write_frame_body(sock: socket.socket, body: bytes) -> None:
+    """Frame pre-encoded (code byte + payload) bytes — the apb codec
+    builds its own bodies."""
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:

@@ -23,12 +23,14 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.proto import apb
 from antidote_tpu.proto.codec import (
     MessageCode,
     decode,
     encode_value,
     freeze,
     read_frame,
+    write_frame_body,
     write_message,
 )
 from antidote_tpu.txn.manager import AbortError, Transaction
@@ -94,6 +96,20 @@ class ProtocolServer:
                         frame = read_frame(self.request)
                     except (ConnectionError, OSError):
                         return
+                    # dialect dispatch on the code byte: antidote_pb
+                    # request codes (apb.APB_REQUEST_CODES) are disjoint
+                    # from the native msgpack codes, so existing
+                    # antidotec_pb clients connect to the same port
+                    if frame and frame[0] in apb.APB_REQUEST_CODES:
+                        resp_body = apb.handle_request(
+                            server_self, frame[0], frame[1:], conn_txns,
+                            lock=server_self._lock,
+                        )
+                        try:
+                            write_frame_body(self.request, resp_body)
+                        except (ConnectionError, OSError):
+                            return
+                        continue
                     try:
                         code, body = decode(frame)
                         resp_code, resp = server_self._process(code, body)
