@@ -1,0 +1,103 @@
+"""Cluster member process entrypoint.
+
+    python -m antidote_tpu.cluster.boot --dc-id 0 --member 1 --members 2 \
+        --shards 4 --max-dcs 3 [--log-dir DIR]
+
+Prints one JSON line with the process' ports:
+    {"rpc": [h, p], "client": [h, p], "fabric": [h, p], "fabric_id": N}
+
+then serves until killed.  A controller (the CT-style test harness, or an
+operator script) wires the topology afterwards through the control RPC:
+
+    ctl_wire(peers, remotes, members_by_dc)
+        peers          {member_id: [host, port]}      intra-DC RPC
+        remotes        {fabric_id: [host, port]}      inter-DC endpoints
+        members_by_dc  {dc_id: n_members}             catch-up routing
+
+— the two-phase bring-up of the reference's CT utilities (boot nodes,
+then exchange descriptors and observe_dcs_sync,
+/root/reference/test/utils/test_utils.erl:110-165,426-451).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="antidote_tpu.cluster.boot")
+    ap.add_argument("--dc-id", type=int, required=True)
+    ap.add_argument("--member", type=int, default=0)
+    ap.add_argument("--members", type=int, default=1)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--max-dcs", type=int, default=4)
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args(argv)
+
+    from antidote_tpu.config import apply_jax_platform_env
+
+    apply_jax_platform_env()
+
+    from antidote_tpu.cluster import (ClusterMember, ClusterNode,
+                                      attach_interdc, cluster_query_router)
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.interdc.tcp import TcpFabric
+    from antidote_tpu.proto.server import ProtocolServer
+
+    cfg = AntidoteConfig(n_shards=args.shards, max_dcs=args.max_dcs)
+    member = ClusterMember(cfg, dc_id=args.dc_id, member_id=args.member,
+                           n_members=args.members, log_dir=args.log_dir)
+    fabric = TcpFabric()
+    replica = attach_interdc(member, fabric)
+    node = ClusterNode(member)
+    server = ProtocolServer(node, port=0)
+
+    def ctl_wire(peers, remotes, members_by_dc) -> bool:
+        for mid, (h, p) in peers.items():
+            mid = int(mid)
+            if mid != member.member_id:
+                member.connect(mid, h, int(p))
+        for fid, (h, p) in remotes.items():
+            fabric.connect_remote(int(fid), h, int(p))
+        replica.route_query = cluster_query_router(
+            {int(k): int(v) for k, v in members_by_dc.items()}, cfg.n_shards
+        )
+        for fid in remotes:
+            fid = int(fid)
+            if fid != replica.fabric_id and (fid & 0xFFFF) != member.dc_id:
+                fabric.subscribe(replica.fabric_id, fid, replica._on_message)
+        # background pump: deliver the inter-DC stream + flush heartbeats
+        t = threading.Thread(target=_pump_loop, args=(fabric,), daemon=True,
+                             name="interdc-pump")
+        t.start()
+        return True
+
+    member.rpc.register("ctl_wire", ctl_wire)
+
+    print(json.dumps({
+        "rpc": list(member.address),
+        "client": [server.host, server.port],
+        "fabric": list(fabric.address_of(replica.fabric_id)),
+        "fabric_id": replica.fabric_id,
+    }), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _pump_loop(fabric) -> None:
+    while True:
+        try:
+            fabric.pump(timeout=0.2)
+        except Exception:
+            time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

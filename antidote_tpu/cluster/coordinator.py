@@ -1,0 +1,295 @@
+"""ClusterNode — transaction coordination over a multi-node DC.
+
+The AntidoteNode-shaped facade a member process serves clients from: any
+member coordinates any transaction (the reference spawns a coordinator
+FSM on whichever node the client hit,
+/root/reference/src/clocksi_interactive_coord.erl), routing per-key work
+to shard owners over the intra-DC RPC:
+
+  reads      -> owner's serving read at the snapshot VC
+  downstream -> stateless ops generate locally; state-dependent ops
+                (observed-remove sets, mv-register, rga index ops, ...)
+                generate at the owner against its replica
+  commit     -> prepare at every involved owner (certify + key lock),
+                then one sequencer timestamp (member 0), then commit
+                fan-out; abort releases the prepared keys
+
+Snapshot clocks come from the aggregated member clock matrix (stale is
+safe: aggregated mins only ever lag the true applied clocks, so a
+snapshot never claims unapplied state).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from antidote_tpu.cluster.member import (ClusterMember, _freeze_op,
+                                         unwire_value)
+from antidote_tpu.cluster.rpc import RpcError, eff_to_wire
+from antidote_tpu.crdt import get_type, is_type
+from antidote_tpu.store.kv import Effect, freeze_key, key_to_shard
+from antidote_tpu.txn.manager import AbortError
+
+
+class ClusterTxn:
+    _ids = itertools.count(1)
+
+    def __init__(self, snapshot_vc: np.ndarray, coord_tag: int):
+        # txids must be unique ACROSS coordinators (owners key prepare
+        # locks by txid): tag the high bits with the member id
+        self.txid = (coord_tag << 48) | next(ClusterTxn._ids)
+        self.snapshot_vc = np.asarray(snapshot_vc, np.int32)
+        self.writeset: List[Effect] = []
+        self.active = True
+
+
+class ClusterNode:
+    """Coordinator facade with the AntidoteNode client surface."""
+
+    def __init__(self, member: ClusterMember):
+        self.member = member
+        self.cfg = member.cfg
+        self.dc_id = member.dc_id
+        self._txns: Dict[int, ClusterTxn] = {}
+        #: session floor: my own commits are in my snapshots even before
+        #: the aggregated stable catches up (read-your-writes across
+        #: transactions; owner reads wait out in-flight commits below the
+        #: requested own-lane ts, so the floor is safe)
+        self.session_vc = np.zeros(self.cfg.max_dcs, np.int32)
+
+    # ------------------------------------------------------------------
+    def _owner_of_shard(self, shard: int) -> Optional[int]:
+        """Peer member id owning a shard; None when it is mine."""
+        if shard in self.member.shards:
+            return None
+        return shard % self.member.n_members
+
+    def _owner_of(self, key, bucket) -> Optional[int]:
+        return self._owner_of_shard(
+            key_to_shard(key, bucket, self.cfg.n_shards)
+        )
+
+    # ------------------------------------------------------------------
+    def start_transaction(self, clock=None, props=None) -> ClusterTxn:
+        snap = np.maximum(self.member.stable_vc(), self.session_vc)
+        # freshest own-lane view (cached sequencer frontier): blind writes
+        # certify against recent commits instead of spuriously aborting,
+        # and reads wait out in-flight commits at the owners (the
+        # reference's check_clock freshness wait does the same job)
+        snap[self.dc_id] = max(int(snap[self.dc_id]),
+                               self.member._seq_counter())
+        if clock is not None:
+            import time as _t
+
+            clock = np.asarray(clock, np.int32)
+            for _ in range(10_000):
+                if (clock <= snap).all():
+                    break
+                # remote lanes advance on wall-clock cadences (inter-DC
+                # heartbeats, gossip caches) — pace the spin so the
+                # iteration bound is ~20 s of real time, not microseconds
+                _t.sleep(0.002)
+                self.member.refresh_peer_clocks()
+                snap = np.maximum(self.member.stable_vc(), self.session_vc)
+            else:
+                raise TimeoutError(
+                    f"stable snapshot {snap} never reached client clock "
+                    f"{clock}"
+                )
+            snap = np.maximum(snap, clock)
+        txn = ClusterTxn(snap, self.member.member_id)
+        self._txns[txn.txid] = txn
+        return txn
+
+    # ------------------------------------------------------------------
+    def read_objects(self, objects: Sequence, txn=None, clock=None):
+        if txn is None:
+            t = self.start_transaction(clock)
+            vals = self._read(objects, t)
+            t.active = False
+            return vals, t.snapshot_vc
+        return self._read(objects, txn)
+
+    def _read(self, objects, txn: ClusterTxn) -> list:
+        assert txn.active
+        if txn.writeset:
+            raise NotImplementedError(
+                "cluster coordinators serve reads-after-writes from the "
+                "owners at commit time; read-your-own-writes within one "
+                "open cluster txn is not supported yet"
+            )
+        out: List[Any] = [None] * len(objects)
+        by_owner: Dict[Optional[int], list] = {}
+        for i, (key, t, bucket) in enumerate(objects):
+            key = freeze_key(key)
+            by_owner.setdefault(self._owner_of(key, bucket), []).append(
+                (i, (key, t, bucket))
+            )
+        for owner, items in by_owner.items():
+            objs = [o for _, o in items]
+            if owner is None:
+                vals = [
+                    unwire_value(v) for v in self.member.m_read_values(
+                        objs, txn.snapshot_vc
+                    )
+                ]
+            else:
+                vals = [
+                    unwire_value(v)
+                    for v in self.member.peers[owner].call(
+                        "m_read_values", objs,
+                        [int(x) for x in txn.snapshot_vc],
+                    )
+                ]
+            for (i, _), v in zip(items, vals):
+                out[i] = v
+        return out
+
+    # ------------------------------------------------------------------
+    def update_objects(self, updates: Sequence, txn=None, clock=None):
+        if txn is None:
+            t = self.start_transaction(clock)
+            self._update(updates, t)
+            return self.commit_transaction(t)
+        self._update(updates, txn)
+
+    def _update(self, updates, txn: ClusterTxn) -> None:
+        assert txn.active
+        for update in updates:
+            key, type_name, bucket, op = update
+            key = freeze_key(key)
+            op = _freeze_op(op)
+            if not is_type(type_name):
+                raise TypeError(f"unknown CRDT type {type_name!r}")
+            ty = get_type(type_name)
+            if not ty.is_operation(op):
+                raise TypeError(f"invalid operation {op!r} for {type_name}")
+            if getattr(ty, "composite", False):
+                from antidote_tpu.crdt import maps as maps_mod
+
+                def read_field_value(fk, ft):
+                    return self._read([(fk, ft, bucket)], txn)[0]
+
+                for sub in maps_mod.expand_update(
+                    key, type_name, bucket, op, read_field_value
+                ):
+                    self._update([sub], txn)
+                continue
+            if ty.require_state_downstream(op):
+                # the owner generates against its replica's state
+                owner = self._owner_of(key, bucket)
+                if owner is None:
+                    wires = self.member.m_downstream(
+                        key, type_name, bucket, op, txn.snapshot_vc
+                    )
+                else:
+                    wires = self.member.peers[owner].call(
+                        "m_downstream", key, type_name, bucket, op,
+                        [int(x) for x in txn.snapshot_vc],
+                    )
+                from antidote_tpu.cluster.rpc import eff_from_wire
+
+                txn.writeset.extend(eff_from_wire(w) for w in wires)
+            else:
+                blobs = self.member.node.store.blobs
+                for a, b, refs in ty.downstream(op, None, blobs, self.cfg):
+                    txn.writeset.append(
+                        Effect(key, type_name, bucket, a, b, refs)
+                    )
+
+    # ------------------------------------------------------------------
+    def commit_transaction(self, txn: ClusterTxn) -> np.ndarray:
+        assert txn.active
+        txn.active = False
+        self._txns.pop(txn.txid, None)
+        if not txn.writeset:
+            return txn.snapshot_vc.copy()
+        by_owner: Dict[Optional[int], list] = {}
+        shards = set()
+        for eff in txn.writeset:
+            shard = key_to_shard(eff.key, eff.bucket, self.cfg.n_shards)
+            shards.add(shard)
+            by_owner.setdefault(self._owner_of_shard(shard), []).append(eff)
+        snap_own = int(txn.snapshot_vc[self.dc_id])
+        prepared: List[Optional[int]] = []
+        try:
+            for owner, effs in by_owner.items():
+                wires = [eff_to_wire(e) for e in effs]
+                if owner is None:
+                    self.member.m_prepare(txn.txid, wires, snap_own)
+                else:
+                    self.member.peers[owner].call(
+                        "m_prepare", txn.txid, wires, snap_own
+                    )
+                prepared.append(owner)
+        except RuntimeError as e:
+            # cert conflicts raise "abort: ..." — locally as RuntimeError,
+            # remotely surfaced through RpcError (a RuntimeError subclass)
+            self._abort_prepared(txn.txid, prepared)
+            if "abort" in str(e):
+                raise AbortError(str(e)) from e
+            raise
+        except Exception:
+            self._abort_prepared(txn.txid, prepared)
+            raise
+        # one DC-wide timestamp + per-shard chains from the sequencer
+        ts, prev = self._seq(sorted(shards))
+        commit_vc = txn.snapshot_vc.copy()
+        commit_vc[self.dc_id] = ts
+        vc_wire = [int(x) for x in commit_vc]
+        prev_wire = {int(k): int(v) for k, v in prev.items()}
+        for owner in by_owner:
+            if owner is None:
+                self.member.m_commit(txn.txid, vc_wire, prev_wire)
+            else:
+                self.member.peers[owner].call(
+                    "m_commit", txn.txid, vc_wire, prev_wire
+                )
+        np.maximum(self.session_vc, commit_vc, out=self.session_vc)
+        return commit_vc
+
+    def _seq(self, shards):
+        if self.member.seq is not None:
+            return self.member.seq.next_ts(shards)
+        ts, prev = self.member.peers[0].call("m_seq", list(shards))
+        return ts, {int(k): int(v) for k, v in prev.items()}
+
+    def _abort_prepared(self, txid: int, owners) -> None:
+        for owner in owners:
+            try:
+                if owner is None:
+                    self.member.m_abort(txid)
+                else:
+                    self.member.peers[owner].call("m_abort", txid)
+            except Exception:
+                pass
+
+    def abort_transaction(self, txn: ClusterTxn) -> None:
+        txn.active = False
+        txn.writeset.clear()
+        self._txns.pop(txn.txid, None)
+
+    # ------------------------------------------------------------------
+    def check_ready(self) -> Dict[str, bool]:
+        probes = {"local": True}
+        for mid, cli in self.member.peers.items():
+            try:
+                probes[f"member{mid}"] = bool(cli.call("m_ready"))
+            except Exception:
+                probes[f"member{mid}"] = False
+        return probes
+
+    def status(self, include_ready: bool = False) -> Dict[str, Any]:
+        out = {
+            "dc_id": self.dc_id,
+            "member": self.member.member_id,
+            "members": self.member.n_members,
+            "owned_shards": sorted(self.member.shards),
+            "stable_vc": [int(x) for x in self.member.stable_vc()],
+        }
+        if include_ready:
+            out["ready"] = self.check_ready()
+        return out

@@ -1,0 +1,405 @@
+"""ClusterMember — one node of a multi-node DC.
+
+The reference builds a DC from several BEAM nodes via riak_core staged
+join (/root/reference/src/antidote_dc_manager.erl:53-81): the ring
+assigns each node a subset of partitions, vnode commands route to owners,
+and per-node stable-time gossip aggregates the DC's stable snapshot
+(/root/reference/src/meta_data_sender.erl:224-255).  Here:
+
+  * shard ownership: member ``i`` of ``n`` owns shards {s : s % n == i}
+    (an explicit list may override);
+  * member 0 is the DC's commit SEQUENCER: it mints the DC-wide own-lane
+    commit timestamps, returning per-shard previous-ts chains so owners
+    apply own-DC commits gap-free in ts order (the same chain discipline
+    the inter-DC opid protocol uses);
+  * owners certify at prepare (first-committer-wins per key + a prepared
+    lock, the prepared_tx ETS of
+    /root/reference/src/clocksi_vnode.erl:83-87,588-632) and apply at
+    commit;
+  * stable time: each member gossips its owned shards' applied clock
+    rows; the DC stable snapshot is the entry-wise min over the
+    assembled (members x shards) matrix via ``stable_min_of`` — the
+    large-matrix path that dispatches to the streaming Pallas kernel.
+
+Coordinators (cluster/coordinator.py) run on any member and drive these
+handlers over the intra-DC RPC.
+
+Known limits vs the reference (documented, not hidden): a coordinator
+crash between sequencing and the commit fan-out wedges that shard chain
+(the reference recovers via riak_core takeover); member restart/rejoin
+re-runs boot rather than handing off live.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.cluster.rpc import RpcClient, RpcServer, eff_from_wire
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.crdt import get_type
+from antidote_tpu.store.kv import freeze_key, key_to_shard, stable_min_of
+
+
+def owned_shards(cfg: AntidoteConfig, member_id: int, n_members: int):
+    return [s for s in range(cfg.n_shards) if s % n_members == member_id]
+
+
+class Sequencer:
+    """DC-wide commit-timestamp authority (member 0).
+
+    ``next_ts(shards)`` -> (ts, {shard: previous ts issued for it}) —
+    the per-shard chain lets owners apply own-DC commits contiguously."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self.last_ts: Dict[int, int] = {}
+
+    def next_ts(self, shards) -> Tuple[int, Dict[int, int]]:
+        with self._lock:
+            self.counter += 1
+            ts = self.counter
+            prev = {}
+            for s in shards:
+                s = int(s)
+                prev[s] = self.last_ts.get(s, 0)
+                self.last_ts[s] = ts
+            return ts, prev
+
+
+class ClusterMember:
+    def __init__(self, cfg: AntidoteConfig, dc_id: int, member_id: int,
+                 n_members: int, log_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", shards=None):
+        self.cfg = cfg
+        self.dc_id = dc_id
+        self.member_id = member_id
+        self.n_members = n_members
+        self.shards = set(shards if shards is not None
+                          else owned_shards(cfg, member_id, n_members))
+        self.node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir)
+        #: sequencer lives on member 0 only
+        self.seq = Sequencer() if member_id == 0 else None
+        #: peer member_id -> RpcClient
+        self.peers: Dict[int, RpcClient] = {}
+        #: peer member_id -> last gossiped [n_shards, D] clock rows
+        #: (only the peer's owned rows are meaningful)
+        self.peer_clocks: Dict[int, np.ndarray] = {}
+        # reentrant: m_commit holds the lock while its apply fires the
+        # inter-DC commit listeners, whose heartbeat path re-enters
+        # prepared_on_shard for the safe-time check
+        self._lock = threading.RLock()
+        #: (key, bucket) -> txid holding the prepare lock
+        self.prepared: Dict[Tuple[Any, str], int] = {}
+        #: txid -> (effects, [keys]) buffered between prepare and commit
+        self.staged: Dict[int, Tuple[list, list]] = {}
+        #: (key, bucket) -> own-lane ts of its last commit (cert table)
+        self.last_commit: Dict[Tuple[Any, str], int] = {}
+        #: per owned shard: last own-DC ts applied (chain frontier)
+        self.applied_ts: Dict[int, int] = {s: 0 for s in self.shards}
+        #: per shard: {prev_ts: (txid, effects, commit_vc)} awaiting chain
+        self.chain_wait: Dict[int, Dict[int, tuple]] = {
+            s: {} for s in self.shards
+        }
+        #: commit listeners (inter-DC egress seam): (effects, vc, origin)
+        self.on_commit: List = []
+        self._seq_cache = 0
+        self._seq_cache_at = 0.0
+        self.rpc = RpcServer(host=host)
+        for name in ("m_read_values", "m_downstream", "m_prepare",
+                     "m_commit", "m_abort", "m_clocks", "m_seq",
+                     "m_ready", "m_seq_counter"):
+            self.rpc.register(name, getattr(self, name))
+
+    # ------------------------------------------------------------------
+    def connect(self, member_id: int, host: str, port: int) -> None:
+        self.peers[member_id] = RpcClient(host, port)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.rpc.host, self.rpc.port)
+
+    # ------------------------------------------------------------------
+    # owner-side handlers (all run on RPC server threads; the node lock
+    # serializes against other mutations)
+    # ------------------------------------------------------------------
+    def m_ready(self) -> bool:
+        return True
+
+    def prepared_on_shard(self, shard: int) -> bool:
+        """Any prepared-but-uncommitted txn touching one of my keys on
+        ``shard`` (gates the heartbeat safe time).  Snapshots the key set
+        under the lock — RPC threads mutate ``prepared`` concurrently."""
+        with self._lock:
+            keys = list(self.prepared)
+        for (key, bucket) in keys:
+            if key_to_shard(key, bucket, self.cfg.n_shards) == shard:
+                return True
+        return False
+
+    def m_seq(self, shards) -> Tuple[int, Dict[int, int]]:
+        assert self.seq is not None, "not the sequencer"
+        ts, prev = self.seq.next_ts(shards)
+        return ts, {int(k): int(v) for k, v in prev.items()}
+
+    def m_seq_counter(self) -> int:
+        assert self.seq is not None, "not the sequencer"
+        return self.seq.counter
+
+    def m_clocks(self) -> list:
+        """My owned shards' applied clock rows: [(shard, [D])]."""
+        self.advance_idle_shards()
+        vc = self.node.store.applied_vc
+        return [(s, [int(x) for x in vc[s]]) for s in sorted(self.shards)]
+
+    def _seq_counter(self) -> int:
+        """The DC timestamp frontier (locally for the sequencer, cached
+        RPC otherwise)."""
+        if self.seq is not None:
+            return self.seq.counter
+        import time as _t
+
+        now = _t.monotonic()
+        if now - self._seq_cache_at > 0.2 and 0 in self.peers:
+            try:
+                self._seq_cache = int(self.peers[0].call("m_seq_counter"))
+                self._seq_cache_at = now
+            except Exception:
+                pass
+        return self._seq_cache
+
+    def advance_idle_shards(self) -> None:
+        """Own-lane safe-time advance for idle owned shards: with no
+        prepared or chain-buffered txn touching a shard, every issued ts
+        is already applied there (prepare precedes sequencing), so its
+        own-lane clock may claim the sequencer frontier — the intra-DC
+        analogue of the single-node heartbeat self-advance, and what lets
+        the aggregated stable snapshot progress past untouched shards."""
+        ctr = self._seq_counter()
+        if ctr == 0:
+            return
+        vc = self.node.store.applied_vc
+        own = self.dc_id
+        for s in self.shards:
+            if self.chain_wait[s] or self.prepared_on_shard(s):
+                continue
+            if vc[s, own] < ctr:
+                vc[s, own] = ctr
+
+    def m_read_values(self, objects, read_vc) -> list:
+        """Owner read: values at ``read_vc`` for my keys (the serving
+        path: store.read_values -> read_resolved).
+
+        Before reading, each involved shard waits until its own-lane
+        clock can safely claim ``read_vc[own]`` — an in-flight commit
+        (prepared here, ts possibly already issued) below that ts would
+        otherwise make the snapshot observe a txn partially, the exact
+        hazard clocksi_readitem_server's check_prepared_list blocks on
+        (/root/reference/src/clocksi_readitem_server.erl:254-264)."""
+        objs = [(freeze_key(k), t, b) for k, t, b in objects]
+        read_vc = np.asarray(read_vc, np.int32)
+        want = int(read_vc[self.dc_id])
+        shards = {
+            key_to_shard(k, b, self.cfg.n_shards) for k, _, b in objs
+        } & self.shards
+        for s in shards:
+            self._wait_read_safe(s, want)
+        with self._lock:
+            vals = self.node.store.read_values(objs, read_vc)
+        return [_wire_value(v) for v in vals]
+
+    def _wait_read_safe(self, shard: int, want_ts: int,
+                        timeout: float = 30.0) -> None:
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while True:
+            self.advance_idle_shards()
+            if int(self.node.store.applied_vc[shard, self.dc_id]) >= want_ts:
+                return
+            if _t.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shard {shard} own-lane stuck below {want_ts} "
+                    "(in-flight commit never arrived?)"
+                )
+            _t.sleep(0.001)
+
+    def m_downstream(self, key, type_name, bucket, op, read_vc) -> list:
+        """Generate downstream effects for a state-dependent op at my
+        replica of the key (clocksi_downstream:generate_downstream_op,
+        /root/reference/src/clocksi_downstream.erl:38-68)."""
+        from antidote_tpu.cluster.rpc import eff_to_wire
+        from antidote_tpu.store.kv import Effect, scaled_cfg, split_tier
+
+        key = freeze_key(key)
+        op = _freeze_op(op)
+        ty = get_type(type_name)
+        read_vc = np.asarray(read_vc, np.int32)
+        # same in-flight-commit gate as m_read_values: a downstream
+        # generated from a snapshot missing a committed-but-unapplied op
+        # would break observed-remove semantics
+        shard = key_to_shard(key, bucket, self.cfg.n_shards)
+        if shard in self.shards:
+            self._wait_read_safe(shard, int(read_vc[self.dc_id]))
+        with self._lock:
+            store = self.node.store
+            state = store.read_states(
+                [(key, type_name, bucket)], read_vc
+            )[0]
+            ent = store.locate(key, type_name, bucket, create=False)
+            cfg_k = store.table(ent[0]).cfg if ent else self.cfg
+            effs = ty.downstream(op, state, store.blobs, cfg_k)
+        return [
+            eff_to_wire(Effect(key, type_name, bucket, a, b, refs))
+            for a, b, refs in effs
+        ]
+
+    def m_prepare(self, txid: int, effs_wire: list, snap_own: int) -> bool:
+        """Certify + lock this txn's keys on my shards
+        (certification_with_check, /root/reference/src/clocksi_vnode.erl:599-624).
+        Raises on conflict (the RPC surfaces it as an error reply)."""
+        effects = [eff_from_wire(w) for w in effs_wire]
+        with self._lock:
+            keys = []
+            for eff in effects:
+                dk = (eff.key, eff.bucket)
+                holder = self.prepared.get(dk)
+                if holder is not None and holder != txid:
+                    raise RuntimeError(
+                        f"abort: key {eff.key!r} prepared by txn {holder}"
+                    )
+                if self.last_commit.get(dk, 0) > snap_own:
+                    raise RuntimeError(
+                        f"abort: certification conflict on {eff.key!r}"
+                    )
+            for eff in effects:
+                dk = (eff.key, eff.bucket)
+                self.prepared[dk] = txid
+                keys.append(dk)
+            self.staged[txid] = (effects, keys)
+        return True
+
+    def m_abort(self, txid: int) -> bool:
+        with self._lock:
+            effects_keys = self.staged.pop(txid, None)
+            if effects_keys is not None:
+                for dk in effects_keys[1]:
+                    if self.prepared.get(dk) == txid:
+                        del self.prepared[dk]
+        return True
+
+    def m_commit(self, txid: int, commit_vc, prev_by_shard) -> bool:
+        """Apply a staged txn at ts = commit_vc[own]; my shards' slices
+        apply in ts order via the sequencer's per-shard chain."""
+        commit_vc = np.asarray(commit_vc, np.int32)
+        ts = int(commit_vc[self.dc_id])
+        with self._lock:
+            effects, keys = self.staged.pop(txid, (None, None))
+            if effects is None:
+                return True  # duplicate commit
+            by_shard: Dict[int, list] = {}
+            for eff in effects:
+                _, shard, _ = self.node.store.locate(
+                    eff.key, eff.type_name, eff.bucket
+                )
+                by_shard.setdefault(shard, []).append(eff)
+            for shard, effs in by_shard.items():
+                prev = int(prev_by_shard.get(str(shard),
+                                             prev_by_shard.get(shard, 0)))
+                self._chain_apply(shard, prev, ts, effs, commit_vc)
+            for dk in keys:
+                if self.prepared.get(dk) == txid:
+                    del self.prepared[dk]
+                self.last_commit[dk] = ts
+        return True
+
+    def _chain_apply(self, shard: int, prev: int, ts: int, effects,
+                     commit_vc) -> None:
+        """Apply when the shard's own-lane chain reaches ``prev``; buffer
+        otherwise (commits may arrive out of ts order from concurrent
+        coordinators)."""
+        if self.applied_ts[shard] < prev:
+            self.chain_wait[shard][prev] = (ts, effects, commit_vc)
+            return
+        self._apply_now(shard, ts, effects, commit_vc)
+        # drain successors whose prev just became current
+        waits = self.chain_wait[shard]
+        while self.applied_ts[shard] in waits:
+            nts, neffs, nvc = waits.pop(self.applied_ts[shard])
+            self._apply_now(shard, nts, neffs, nvc)
+
+    def _apply_now(self, shard: int, ts: int, effects, commit_vc) -> None:
+        self.node.store.apply_effects(
+            effects, [commit_vc] * len(effects), [self.dc_id] * len(effects)
+        )
+        self.applied_ts[shard] = ts
+        for listener in self.on_commit:
+            listener(effects, commit_vc, self.dc_id)
+
+    # ------------------------------------------------------------------
+    # stable-time aggregation (meta_data_sender stable-time gossip)
+    # ------------------------------------------------------------------
+    def refresh_peer_clocks(self) -> None:
+        for mid, cli in self.peers.items():
+            rows = cli.call("m_clocks")
+            mat = self.peer_clocks.get(mid)
+            if mat is None:
+                mat = np.zeros((self.cfg.n_shards, self.cfg.max_dcs),
+                               np.int32)
+                self.peer_clocks[mid] = mat
+            for s, row in rows:
+                np.maximum(mat[s], np.asarray(row, np.int32), out=mat[s])
+
+    def clock_matrix(self) -> np.ndarray:
+        """The DC's full (shards x D) applied matrix: my owned rows live,
+        peer rows from gossip."""
+        mat = self.node.store.applied_vc.copy()
+        for mid, peer in self.peer_clocks.items():
+            for s in range(self.cfg.n_shards):
+                if s not in self.shards:
+                    np.maximum(mat[s], peer[s], out=mat[s])
+        return mat
+
+    def stable_vc(self) -> np.ndarray:
+        """DC stable snapshot = entry-wise min over every member's shard
+        rows (stable_time_functions:get_min_time aggregated across nodes,
+        /root/reference/src/meta_data_sender.erl:224-255)."""
+        self.advance_idle_shards()
+        return stable_min_of(self.clock_matrix(),
+                             getattr(self.cfg, "use_pallas", False))
+
+    def close(self) -> None:
+        self.rpc.close()
+        for cli in self.peers.values():
+            cli.close()
+
+
+def _wire_value(v):
+    """Client values over msgpack: map dicts have tuple keys."""
+    if isinstance(v, dict):
+        return {"__map__": [[list(k), _wire_value(x)] for k, x in v.items()]}
+    if isinstance(v, (list, tuple)):
+        return [_wire_value(x) for x in v]
+    return v
+
+
+def unwire_value(v):
+    if isinstance(v, dict) and "__map__" in v:
+        return {
+            (freeze_key(k[0]), k[1]): unwire_value(x) for k, x in v["__map__"]
+        }
+    if isinstance(v, list):
+        return [unwire_value(x) for x in v]
+    return v
+
+
+def _freeze_op(op):
+    """Ops over msgpack come back as lists; freeze to the tuple shapes the
+    type layer expects."""
+    if isinstance(op, list):
+        return tuple(_freeze_op(x) for x in op)
+    return op

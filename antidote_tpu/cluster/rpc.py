@@ -1,0 +1,159 @@
+"""Intra-DC RPC: msgpack request/reply over TCP.
+
+The stand-in for disterl between a DC's member nodes (the reference
+spreads one DC over several BEAM nodes joined through riak_core,
+/root/reference/src/antidote_dc_manager.erl:53-81; vnode commands travel
+the Erlang distribution).  One threaded server per member; clients keep
+one connection per (thread, target) like the inter-DC query channel.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+import msgpack
+import numpy as np
+
+_HDR = struct.Struct(">I")
+
+
+class RpcError(RuntimeError):
+    """The remote handler raised; carries the remote repr."""
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    data = msgpack.packb(obj, use_bin_type=True, default=_np_default)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _np_default(x):
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    raise TypeError(f"not msgpack-able: {type(x)}")
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = _read_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    return msgpack.unpackb(_read_exact(sock, n), raw=False,
+                           strict_map_key=False)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RpcServer:
+    """Dispatches {"m": method, "a": [args]} to registered handlers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: Dict[str, Callable] = {}
+        srv_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        fn = srv_self.handlers[req["m"]]
+                        reply = {"ok": fn(*req.get("a", []))}
+                    except Exception as e:
+                        reply = {"err": f"{type(e).__name__}: {e}"}
+                    try:
+                        _send(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"cluster-rpc:{self.port}",
+        )
+        self._thread.start()
+
+    def register(self, name: str, fn: Callable) -> None:
+        self.handlers[name] = fn
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """One connection per calling thread; calls are synchronous."""
+
+    def __init__(self, host: str, port: int):
+        self.addr = (host, port)
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        s = getattr(self._local, "sock", None)
+        if s is None:
+            s = socket.create_connection(self.addr)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = s
+        return s
+
+    def call(self, method: str, *args) -> Any:
+        s = self._sock()
+        try:
+            _send(s, {"m": method, "a": list(args)})
+            reply = _recv(s)
+        except (ConnectionError, OSError):
+            # one reconnect: the server may have restarted between calls
+            self._local.sock = None
+            s = self._sock()
+            _send(s, {"m": method, "a": list(args)})
+            reply = _recv(s)
+        if "err" in reply:
+            raise RpcError(reply["err"])
+        return reply["ok"]
+
+    def close(self) -> None:
+        s = getattr(self._local, "sock", None)
+        if s is not None:
+            s.close()
+            self._local.sock = None
+
+
+# ---------------------------------------------------------------------------
+# wire form for effects (coordinator <-> owner)
+# ---------------------------------------------------------------------------
+def eff_to_wire(eff) -> dict:
+    return {
+        "k": eff.key, "t": eff.type_name, "b": eff.bucket,
+        "a": np.asarray(eff.eff_a, np.int64).tobytes(),
+        "eb": np.asarray(eff.eff_b, np.int32).tobytes(),
+        "bl": [(int(h), bytes(d)) for h, d in eff.blob_refs],
+    }
+
+
+def eff_from_wire(w: dict):
+    from antidote_tpu.store.kv import Effect, freeze_key
+
+    return Effect(
+        freeze_key(w["k"]), w["t"], w["b"],
+        np.frombuffer(w["a"], np.int64),
+        np.frombuffer(w["eb"], np.int32),
+        [(int(h), bytes(d)) for h, d in w.get("bl", [])],
+    )

@@ -72,3 +72,19 @@ class AntidoteConfig:
 
 
 DEFAULT_CONFIG = AntidoteConfig()
+
+
+def apply_jax_platform_env() -> None:
+    """Mirror JAX_PLATFORMS into jax.config BEFORE any jax op.
+
+    The axon site wrapper probes the TPU backend on default-backend
+    resolution even under JAX_PLATFORMS=cpu (its anti-silent-fallback
+    design) and can hang on a dead tunnel; jax.config.update is honored.
+    Every process entrypoint calls this first."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and "," not in want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

@@ -57,14 +57,9 @@ def resolve_serve_shape(log_dir, shards, max_dcs):
 def cmd_serve(args) -> int:
     import os
 
-    # honor JAX_PLATFORMS through jax.config BEFORE any jax op: plugin
-    # discovery can probe unavailable accelerator backends (and hang on a
-    # dead tunnel) even when the env var says cpu
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
+    from antidote_tpu.config import apply_jax_platform_env
 
-        jax.config.update("jax_platforms", plat)
+    apply_jax_platform_env()
 
     from antidote_tpu.api import AntidoteNode
     from antidote_tpu.config import AntidoteConfig

@@ -43,11 +43,25 @@ class DCReplica:
     HEARTBEAT_INTERVAL_S = 1.0
     HEARTBEAT_EVERY_COMMITS = 64
 
-    def __init__(self, node: AntidoteNode, hub: LoopbackHub, name: str = ""):
+    def __init__(self, node: AntidoteNode, hub: LoopbackHub, name: str = "",
+                 shards=None, fabric_id: int = None):
         self.node = node
         self.hub = hub
         self.name = name or f"dc{node.dc_id}"
         self.dc_id = node.dc_id
+        #: shards this endpoint owns.  A single-node DC owns all of them;
+        #: a multi-node DC's members each publish/ingest only their own
+        #: shards' chains (one publisher per (origin, shard), like the
+        #: reference's per-partition log senders)
+        self.shards = (set(range(node.cfg.n_shards)) if shards is None
+                       else set(shards))
+        #: id this endpoint registers under on the fabric — cluster
+        #: members of one DC need distinct endpoints (dc_id stays the
+        #: semantic origin in every message)
+        self.fabric_id = self.dc_id if fabric_id is None else fabric_id
+        #: (origin_dc, shard) -> fabric id serving that chain's catch-up
+        #: queries (identity for single-node DCs)
+        self.route_query = lambda origin, shard: origin
         p = node.cfg.n_shards
         #: egress opid chain per shard (my origin)
         self.pub_opid = np.zeros(p, np.int64)
@@ -63,6 +77,8 @@ class DCReplica:
         self._sent_lock = threading.Lock()
         self._commits_since_hb = 0
         self._last_hb = time.monotonic()
+        #: per-shard safe time last pinged (drives the tick-path flush)
+        self._published_safe: Dict[int, int] = {}
         #: ingress: last delivered opid per (origin, shard)
         self.last_seen: Dict[Tuple[int, int], int] = {}
         #: ingress: out-of-order buffer per (origin, shard)
@@ -73,10 +89,10 @@ class DCReplica:
         self.gate: Dict[Tuple[int, int], collections.deque] = (
             collections.defaultdict(collections.deque)
         )
-        hub.register(self.dc_id, self._on_message, self._serve_log_query)
-        hub.register_request(self.dc_id, self._serve_request)
+        hub.register(self.fabric_id, self._on_message, self._serve_log_query)
+        hub.register_request(self.fabric_id, self._serve_request)
         if hasattr(hub, "register_tick"):
-            hub.register_tick(self.dc_id, self.maybe_heartbeat)
+            hub.register_tick(self.fabric_id, self.maybe_heartbeat)
         node.txm.commit_listeners.append(self._on_local_commit)
         node.txm.on_clock_wait = self._on_clock_wait
         # bcounter rights requests ride the query channel (?BCOUNTER_REQUEST)
@@ -148,7 +164,7 @@ class DCReplica:
         """
         store = self.node.store
         assert store.log is not None, "restore_from_log needs a WAL"
-        for shard in range(self.node.cfg.n_shards):
+        for shard in sorted(self.shards):
             counts: Dict[int, int] = collections.defaultdict(int)
             for origin, vc, effs in self._wal_txn_groups(shard):
                 counts[origin] += 1
@@ -170,8 +186,8 @@ class DCReplica:
         stand-in for riak_core blocking vnode commands during ownership
         handoff.  The single-threaded LoopbackHub needs no lock."""
         eps = getattr(self.hub, "endpoints", None)
-        if eps and self.dc_id in eps:
-            return eps[self.dc_id].lock
+        if eps and self.fabric_id in eps:
+            return eps[self.fabric_id].lock
         import contextlib
 
         return contextlib.nullcontext()
@@ -183,7 +199,7 @@ class DCReplica:
         """Subscribe to a remote DC's txn stream
         (inter_dc_manager:observe_dcs_sync,
         /root/reference/src/inter_dc_manager.erl:67-109)."""
-        self.hub.subscribe(self.dc_id, remote.dc_id, self._on_message)
+        self.hub.subscribe(self.fabric_id, remote.fabric_id, self._on_message)
 
     @staticmethod
     def connect_all(replicas: List["DCReplica"]) -> None:
@@ -215,7 +231,7 @@ class DCReplica:
             )
             with self._sent_lock:
                 self.sent[shard].append(msg)
-            self.hub.publish(self.dc_id, msg.to_bytes())
+            self.hub.publish(self.fabric_id, msg.to_bytes())
         # idle-shard safe times are NOT broadcast per commit — that would
         # be O(n_shards) fabric messages per txn (r2 VERDICT weak #5).
         # They flush on the interval/commit-count thresholds below and at
@@ -228,29 +244,43 @@ class DCReplica:
             self.heartbeat()
 
     def maybe_heartbeat(self) -> None:
-        """Flush deferred safe-time pings iff commits happened since the
-        last flush (tick path: called at every fabric pump, so a peer
-        blocked on my lane's safe time is unblocked promptly without any
-        per-commit broadcast)."""
+        """Flush deferred safe-time pings iff something new is worth
+        publishing: commits since the last flush, or a shard's safe time
+        advancing past what was last pinged (cluster members' safe times
+        move with the DC sequencer even when this member saw no commit).
+        Tick path: called at every fabric pump, so a peer blocked on my
+        lane is unblocked promptly without any per-commit broadcast."""
         if self._commits_since_hb > 0:
             self.heartbeat()
+            return
+        for shard in self.shards:
+            if int(self.safe_time(shard)) > self._published_safe.get(shard, 0):
+                self.heartbeat()
+                return
+
+    def safe_time(self, shard: int) -> int:
+        """Largest own-lane ts such that no future local commit on
+        ``shard`` can carry a smaller one.  Single-node DCs mint commits
+        from one monotone counter applied synchronously, so the counter
+        itself is safe for every shard.  Cluster members override this
+        (their safe time is the sequencer frontier, gated on outstanding
+        prepared txns)."""
+        return self.node.txm.commit_counter
 
     def heartbeat(self, exclude=frozenset()) -> None:
-        """Broadcast the origin's safe time for every shard: no future local
-        commit will carry a smaller origin timestamp (commits are minted
-        from a monotone counter)."""
+        """Broadcast per-shard safe times (the reference's per-partition
+        min-prepared heartbeat,
+        /root/reference/src/inter_dc_log_sender_vnode.erl:133-143).  Also
+        advances MY lane on idle local shards: without it, a remote txn
+        whose snapshot depends on my lane would gate forever on shards I
+        never wrote to."""
         self._commits_since_hb = 0
         self._last_hb = time.monotonic()
-        safe = self.node.txm.commit_counter
-        # advance MY lane on idle local shards too: local commits apply
-        # synchronously, so every own-lane op ≤ safe is already applied on
-        # every shard — without this, a remote txn whose snapshot depends
-        # on my lane would gate forever on shards I never wrote to (the
-        # reference's per-partition safe time does the same job,
-        # /root/reference/src/inter_dc_log_sender_vnode.erl:133-143)
         vc = self.node.store.applied_vc
-        np.maximum(vc[:, self.dc_id], safe, out=vc[:, self.dc_id])
-        for shard in range(self.node.cfg.n_shards):
+        for shard in sorted(self.shards):
+            safe = int(self.safe_time(shard))
+            vc[shard, self.dc_id] = max(vc[shard, self.dc_id], safe)
+            self._published_safe[shard] = safe
             if shard in exclude:
                 continue
             prev = int(self.pub_opid[shard])
@@ -261,7 +291,7 @@ class DCReplica:
                 snapshot_vc=np.zeros(self.node.cfg.max_dcs, np.int32),
                 effects=[], timestamp=safe,
             )
-            self.hub.publish(self.dc_id, msg.to_bytes())
+            self.hub.publish(self.fabric_id, msg.to_bytes())
 
     def _serve_request(self, kind: str, payload) -> object:
         """Generic query-channel dispatch (inter_dc_query_receive_socket,
@@ -334,7 +364,7 @@ class DCReplica:
     # ------------------------------------------------------------------
     def _on_message(self, data: bytes) -> None:
         msg = TxnMessage.from_bytes(data)
-        if msg.origin == self.dc_id:
+        if msg.origin == self.dc_id or msg.shard not in self.shards:
             return
         key = (msg.origin, msg.shard)
         last = self.last_seen.get(key, 0)
@@ -356,7 +386,8 @@ class DCReplica:
 
     def _catch_up(self, key, from_opid) -> None:
         origin, shard = key
-        for data in self.hub.query_log(origin, shard, origin, from_opid):
+        target = self.route_query(origin, shard)
+        for data in self.hub.query_log(target, shard, origin, from_opid):
             m = TxnMessage.from_bytes(data)
             if not m.is_ping and m.prev_opid == self.last_seen.get(key, 0):
                 self._accept(key, m)
@@ -440,5 +471,9 @@ class DCReplica:
     def _on_clock_wait(self) -> None:
         """Called by the txn manager while waiting for the stable snapshot
         to catch up to a client clock (the wait_for_clock spin,
-        /root/reference/src/clocksi_interactive_coord.erl:915-926)."""
-        self.hub.pump()
+        /root/reference/src/clocksi_interactive_coord.erl:915-926).  An
+        idle pump sleeps a moment so the spin paces real time — cluster
+        peers' safe times advance on wall-clock cadences (sequencer cache,
+        heartbeat timers), not on our loop iterations."""
+        if self.hub.pump() == 0:
+            time.sleep(0.002)

@@ -1,0 +1,176 @@
+"""CT tier-3: four OS processes over real sockets (r2 VERDICT item 7).
+
+The reference's Common Test harness boots several BEAM nodes on one
+machine and clusters them ([n1, n2], [n3], [n4] — two-node DC0 plus two
+single-node DCs), then runs the multiple_dcs/inter_dc_repl causality and
+atomicity cases (/root/reference/test/utils/test_utils.erl:110-165,
+/root/reference/test/multidc/).  This suite does exactly that with
+``python -m antidote_tpu.cluster.boot`` processes: every hop — client
+protocol, intra-DC RPC, inter-DC stream + catch-up — crosses a real
+socket between real processes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from antidote_tpu.cluster.rpc import RpcClient
+from antidote_tpu.proto.client import AntidoteClient
+
+TOPOLOGY = [
+    # (dc_id, member, members)
+    (0, 0, 2),
+    (0, 1, 2),
+    (1, 0, 1),
+    (2, 0, 1),
+]
+
+
+@pytest.fixture(scope="module")
+def procs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    spawned, infos = [], []
+    try:
+        for dc, member, members in TOPOLOGY:
+            p = subprocess.Popen(
+                [sys.executable, "-m", "antidote_tpu.cluster.boot",
+                 "--dc-id", str(dc), "--member", str(member),
+                 "--members", str(members), "--shards", "4",
+                 "--max-dcs", "3"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+            )
+            spawned.append(p)
+        for p in spawned:
+            line = p.stdout.readline().decode()
+            assert line, "boot process died before announcing"
+            infos.append(json.loads(line))
+        # phase 2: wire the topology through each process' control RPC
+        remotes = {info["fabric_id"]: info["fabric"] for info in infos}
+        members_by_dc = {0: 2, 1: 1, 2: 1}
+        for (dc, member, members), info in zip(TOPOLOGY, infos):
+            peers = {
+                m: i["rpc"]
+                for (d2, m, _), i in zip(TOPOLOGY, infos) if d2 == dc
+            }
+            ctl = RpcClient(*info["rpc"])
+            assert ctl.call("ctl_wire", peers, remotes, members_by_dc)
+            ctl.close()
+        yield infos
+    finally:
+        for p in spawned:
+            p.terminate()
+        for p in spawned:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _client(info):
+    return AntidoteClient(*info["client"])
+
+
+def _read_at(client, objects, clock, tries=200):
+    for _ in range(tries):
+        try:
+            return client.read_objects(objects, clock=clock)
+        except Exception:
+            time.sleep(0.05)
+    return client.read_objects(objects, clock=clock)
+
+
+def test_replication_across_four_processes(procs):
+    n1, n2, dc1, dc2 = procs
+    c1 = _client(n1)
+    # keys on both DC0 members' shards (int key k -> shard k % 4;
+    # member 0 owns {0, 2}, member 1 owns {1, 3})
+    vc = c1.update_objects([
+        (0, "counter_pn", "b", ("increment", 11)),
+        (1, "set_aw", "b", ("add", "spread")),
+    ])
+    for info in (dc1, dc2):
+        c = _client(info)
+        vals, _ = _read_at(c, [(0, "counter_pn", "b"), (1, "set_aw", "b")],
+                           vc)
+        assert vals[0] == 11 and vals[1] == ["spread"]
+        c.close()
+    # the second DC0 member serves the same data (intra-DC routing)
+    c2 = _client(n2)
+    vals, _ = _read_at(c2, [(0, "counter_pn", "b"), (1, "set_aw", "b")], vc)
+    assert vals[0] == 11 and vals[1] == ["spread"]
+    c1.close(), c2.close()
+
+
+def test_causality_chain_across_dcs(procs):
+    n1, n2, dc1, dc2 = procs
+    # DC0 (via member 1) writes x; DC1 reads x then writes y; DC2 reading
+    # at y's clock MUST see x (transitive causality through three DCs)
+    c2 = _client(n2)
+    vcx = c2.update_objects([("x", "counter_pn", "cb", ("increment", 1))])
+    c_dc1 = _client(dc1)
+    vals, vc_read = _read_at(c_dc1, [("x", "counter_pn", "cb")], vcx)
+    assert vals[0] == 1
+    vcy = c_dc1.update_objects([("y", "counter_pn", "cb", ("increment", 2))],
+                               clock=vc_read)
+    c_dc2 = _client(dc2)
+    vals, _ = _read_at(c_dc2, [("y", "counter_pn", "cb"),
+                               ("x", "counter_pn", "cb")], vcy)
+    assert vals[0] == 2
+    assert vals[1] == 1, "causality violated: y visible without x"
+    c2.close(), c_dc1.close(), c_dc2.close()
+
+
+def test_atomic_multi_member_txn_visibility(procs):
+    n1, n2, dc1, _ = procs
+    c1 = _client(n1)
+    # one interactive txn spanning BOTH DC0 members' shards
+    txn = c1.start_transaction()
+    txn.update_objects([
+        (4, "counter_pn", "ab", ("increment", 1)),   # shard 0 -> member 0
+        (5, "counter_pn", "ab", ("increment", 1)),   # shard 1 -> member 1
+    ])
+    vc = txn.commit()
+    c_dc1 = _client(dc1)
+    vals, _ = _read_at(c_dc1, [(4, "counter_pn", "ab"),
+                               (5, "counter_pn", "ab")], vc)
+    assert vals == [1, 1]
+    # snapshots never show the txn partially: sample unpinned reads
+    for _ in range(10):
+        vals, _ = c_dc1.read_objects([(4, "counter_pn", "ab"),
+                                      (5, "counter_pn", "ab")])
+        assert vals in ([0, 0], [1, 1]), f"partial txn visible: {vals}"
+    c1.close(), c_dc1.close()
+
+
+def _update_retrying(client, updates, tries=50):
+    """Cert aborts are first-committer-wins doing its job; clients retry
+    (exactly how basho_bench drives the reference)."""
+    from antidote_tpu.proto.client import RemoteAbort
+
+    for _ in range(tries):
+        try:
+            return client.update_objects(updates)
+        except RemoteAbort:
+            time.sleep(0.02)
+    return client.update_objects(updates)
+
+
+def test_concurrent_writes_from_both_members_converge(procs):
+    n1, n2, dc1, dc2 = procs
+    c1, c2 = _client(n1), _client(n2)
+    vc1 = _update_retrying(c1, [("cs", "set_aw", "vb", ("add", "from-n1"))])
+    vc2 = _update_retrying(c2, [("cs", "set_aw", "vb", ("add", "from-n2"))])
+    top = [max(a, b) for a, b in zip(vc1, vc2)]
+    for info in procs:
+        c = _client(info)
+        vals, _ = _read_at(c, [("cs", "set_aw", "vb")], top)
+        assert sorted(vals[0]) == ["from-n1", "from-n2"]
+        c.close()
+    c1.close(), c2.close()
