@@ -324,7 +324,9 @@ def reshard(store: KVStore, new_cfg, log=None, my_dc: int | None = None,
 
 def _reshard_locked(store: KVStore, new_cfg, log) -> KVStore:
     old_cfg = store.cfg
-    new = KVStore(new_cfg, log=log)
+    # keep the device placement: a mesh-sharded replica must come out of a
+    # ring resize still laid out over its mesh (its axis size permitting)
+    new = KVStore(new_cfg, sharding=store.sharding, log=log)
 
     items = list(store.directory.items())
     keys = [dk[0] for dk, _ in items]
