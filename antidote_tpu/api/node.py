@@ -252,6 +252,60 @@ class AntidoteNode:
     def abort_transaction(self, txn: Transaction) -> None:
         self.txm.abort_transaction(txn)
 
+    def get_log_operations(self, object_clock_pairs: Sequence) -> list:
+        """Logged update operations newer than a snapshot time, per object
+        (``antidote:get_log_operations``,
+        /root/reference/src/antidote.erl:69-90).
+
+        ``object_clock_pairs`` is ``[((key, type, bucket), clock), ...]``;
+        ``clock`` is a dense VC (``None`` = all ops).  Returns one list per
+        object of ``(opid, op)`` dicts where ``op`` carries the origin
+        lane, commit VC, and decoded effect — an op is included iff its
+        commit VC is NOT dominated by the given clock (the reference's
+        ``get_from_time`` newer-than filter,
+        /root/reference/src/logging_vnode.erl:194-200).
+        """
+        from antidote_tpu.store.kv import effect_from_rec, freeze_key
+        from antidote_tpu.store.kv import key_to_shard
+
+        log = self.store.log
+        if log is None:
+            raise RuntimeError("get_log_operations requires a durable log "
+                               "(node started with log_dir)")
+        wanted: dict = {}  # (shard) -> [(out_idx, key, type, bucket, vc)]
+        for i, ((key, type_name, bucket), clock) in enumerate(
+                object_clock_pairs):
+            key = freeze_key(key)
+            shard = key_to_shard(key, bucket, self.cfg.n_shards)
+            vc = None
+            if clock is not None:
+                vc = np.zeros(self.cfg.max_dcs, np.int64)
+                clock = np.asarray(clock, np.int64)
+                vc[: len(clock)] = clock[: self.cfg.max_dcs]
+            wanted.setdefault(shard, []).append(
+                (i, key, type_name, bucket, vc))
+        out: list = [[] for _ in object_clock_pairs]
+        for shard, items in wanted.items():
+            by_obj: dict = {}  # an object may be asked at several clocks
+            for i, k, t, b, vc in items:
+                by_obj.setdefault((k, t, b), []).append((i, vc))
+            for rec in log.replay_shard(shard):  # one scan per shard
+                hits = by_obj.get((freeze_key(rec["k"]), rec["t"], rec["b"]))
+                if hits is None:
+                    continue
+                rec_vc = np.zeros(self.cfg.max_dcs, np.int64)
+                rv = np.asarray(rec["vc"], np.int64)
+                rec_vc[: len(rv)] = rv[: self.cfg.max_dcs]
+                for i, vc in hits:
+                    if vc is not None and (rec_vc <= vc).all():
+                        continue  # op already in the given snapshot
+                    out[i].append((int(rec["id"]), {
+                        "origin": int(rec["o"]),
+                        "commit_vc": rec_vc,
+                        "effect": effect_from_rec(rec),
+                    }))
+        return out
+
     # --- hooks (antidote.erl register_pre/post_hook) -------------------
     def register_pre_hook(self, bucket: str, fn) -> None:
         self.txm.hooks.register_pre_hook(bucket, fn)

@@ -54,17 +54,51 @@ def _vc(x) -> Optional[np.ndarray]:
 
 class ProtocolServer:
     def __init__(self, node: AntidoteNode, host: str = "127.0.0.1",
-                 port: int = 0, interdc=None):
+                 port: int = 0, interdc=None, max_connections: int = 1024):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
         self._lock = threading.Lock()
         self._txns: Dict[int, Transaction] = {}
+        #: connection cap (the reference's ranch listener caps at 1024,
+        #: /root/reference/src/antidote_pb_sup.erl:47-56).  The accept
+        #: loop blocks on the semaphore when the cap is reached, so
+        #: excess connections queue in the kernel listen backlog instead
+        #: of exhausting server threads — ranch's backpressure shape.
+        self.max_connections = max_connections
+        self._conn_slots = threading.BoundedSemaphore(max_connections)
         handler = self._make_handler()
+        conn_slots = self._conn_slots
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+            closing = False
+
+            def shutdown(self):
+                self.closing = True
+                super().shutdown()
+
+            def process_request(self, request, client_address):
+                # hold the accept loop until a slot frees: backpressure,
+                # not thread-per-connection without bound.  Poll so a
+                # shutdown() issued while the cap is saturated can still
+                # unpark the serve_forever loop instead of deadlocking.
+                while not conn_slots.acquire(timeout=0.1):
+                    if self.closing:
+                        self.shutdown_request(request)
+                        return
+                try:
+                    super().process_request(request, client_address)
+                except BaseException:
+                    conn_slots.release()
+                    raise
+
+            def process_request_thread(self, request, client_address):
+                try:
+                    super().process_request_thread(request, client_address)
+                finally:
+                    conn_slots.release()
 
         self._server = Server((host, port), handler)
         self.host, self.port = self._server.server_address

@@ -22,6 +22,7 @@ moves with one gather + one scatter per array — no per-key work.
 from __future__ import annotations
 
 import contextlib
+import logging
 from typing import Any, Dict, List, Optional
 
 import msgpack
@@ -325,8 +326,22 @@ def reshard(store: KVStore, new_cfg, log=None, my_dc: int | None = None,
 def _reshard_locked(store: KVStore, new_cfg, log) -> KVStore:
     old_cfg = store.cfg
     # keep the device placement: a mesh-sharded replica must come out of a
-    # ring resize still laid out over its mesh (its axis size permitting)
-    new = KVStore(new_cfg, sharding=store.sharding, log=log)
+    # ring resize still laid out over its mesh (its axis size permitting).
+    # jax.device_put over the shard mesh axis of size M requires
+    # n_shards % M == 0; an incompatible resize (e.g. 8->4 on an 8-device
+    # mesh) falls back to default placement rather than crashing mid-copy.
+    sharding = store.sharding
+    if sharding is not None:
+        from antidote_tpu.parallel.spmd import SHARD_AXIS
+
+        mesh_axis = dict(sharding.mesh.shape).get(SHARD_AXIS, 1)
+        if new_cfg.n_shards % mesh_axis != 0:
+            logging.getLogger(__name__).warning(
+                "reshard to n_shards=%d is not divisible by the mesh "
+                "'%s' axis (%d): new store falls back to default device "
+                "placement", new_cfg.n_shards, SHARD_AXIS, mesh_axis)
+            sharding = None
+    new = KVStore(new_cfg, sharding=sharding, log=log)
 
     items = list(store.directory.items())
     keys = [dk[0] for dk, _ in items]

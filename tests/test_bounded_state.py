@@ -74,6 +74,10 @@ def test_commit_publishes_only_touched_shards():
     r0 = _mk_dc(0, hub)
     r1 = _mk_dc(1, hub)
     DCReplica.connect_all([r0, r1])
+    # pin the wall-clock heartbeat out of the way: first-compile latency can
+    # stretch the commit loop past 1 s, and a mid-loop timer flush would
+    # break the exact message counts this test is about
+    r0.HEARTBEAT_INTERVAL_S = r1.HEARTBEAT_INTERVAL_S = 1e9
     published = []
     orig = hub.publish
     hub.publish = lambda f, d: (published.append(f), orig(f, d))

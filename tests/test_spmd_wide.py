@@ -131,7 +131,7 @@ def test_mesh_read_while_commit_interleaving():
     assert vals[0] == ["v1", "w0", "w1", "w2"]
 
 
-def test_reshard_keeps_mesh_layout(tmp_path):
+def test_reshard_keeps_mesh_layout():
     """Ring resize 8→16 of a mesh-sharded replica: the new store's arrays
     stay on the mesh (16 % 8 == 0) and every value survives re-routing."""
     mesh, sharding = mesh_and_sharding()
@@ -146,6 +146,26 @@ def test_reshard_keeps_mesh_layout(tmp_path):
         expect[(f"s{i}", "set_aw", "bk")] = [f"e{i}"]
     new_store = handoff.reshard(node.store, mk_cfg(16), my_dc=0)
     assert_on_mesh(new_store.tables["counter_pn"], sharding)
+    node2 = AntidoteNode(store=new_store)
+    vals, _ = node2.read_objects(list(expect))
+    for (obj, want), got in zip(expect.items(), vals):
+        assert got == want, (obj, got, want)
+
+
+def test_reshard_shrink_incompatible_mesh_falls_back():
+    """Ring resize 8→4 of a mesh-sharded replica on an 8-device mesh:
+    4 % 8 != 0 so the new store can't keep the mesh layout — reshard
+    falls back to default placement instead of crashing, and every value
+    survives re-routing."""
+    mesh, sharding = mesh_and_sharding()
+    node = AntidoteNode(mk_cfg(8), sharding=sharding)
+    expect = {}
+    for i in range(12):
+        node.update_objects([
+            (f"c{i}", "counter_pn", "bk", ("increment", i + 1))])
+        expect[(f"c{i}", "counter_pn", "bk")] = i + 1
+    new_store = handoff.reshard(node.store, mk_cfg(4), my_dc=0)
+    assert new_store.cfg.n_shards == 4
     node2 = AntidoteNode(store=new_store)
     vals, _ = node2.read_objects(list(expect))
     for (obj, want), got in zip(expect.items(), vals):
