@@ -36,6 +36,8 @@ def main(argv=None) -> int:
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--max-dcs", type=int, default=4)
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--recover", action="store_true",
+                    help="rejoin: replay the WAL + prepare log")
     args = ap.parse_args(argv)
 
     from antidote_tpu.config import apply_jax_platform_env
@@ -50,7 +52,8 @@ def main(argv=None) -> int:
 
     cfg = AntidoteConfig(n_shards=args.shards, max_dcs=args.max_dcs)
     member = ClusterMember(cfg, dc_id=args.dc_id, member_id=args.member,
-                           n_members=args.members, log_dir=args.log_dir)
+                           n_members=args.members, log_dir=args.log_dir,
+                           recover=args.recover)
     fabric = TcpFabric()
     replica = attach_interdc(member, fabric)
     node = ClusterNode(member)
@@ -77,6 +80,11 @@ def main(argv=None) -> int:
         return True
 
     member.rpc.register("ctl_wire", ctl_wire)
+    # takeover/test controls (the CT suite's fault-injection seams)
+    member.rpc.register("ctl_failpoint",
+                        lambda name: setattr(node, "failpoint", name) or True)
+    member.rpc.register("ctl_resolve",
+                        lambda grace=0.0: member.resolve_wedged(grace))
 
     print(json.dumps({
         "rpc": list(member.address),

@@ -22,6 +22,7 @@ snapshot never claims unapplied state).
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,12 +36,16 @@ from antidote_tpu.txn.manager import AbortError
 
 
 class ClusterTxn:
-    _ids = itertools.count(1)
+    # Seeded with the boot time in microseconds (40 bits): txids must be
+    # unique across coordinators AND across process restarts — the
+    # takeover outcome tables (committed/aborted/resolutions) are durable
+    # and keyed by txid, so a restarted coordinator reusing an old txid
+    # would inherit a dead transaction's fate.  Time advances faster than
+    # any coordinator issues txns, so each boot's range is disjoint.
+    _ids = itertools.count(time.time_ns() // 1000 & ((1 << 40) - 1))
 
     def __init__(self, snapshot_vc: np.ndarray, coord_tag: int):
-        # txids must be unique ACROSS coordinators (owners key prepare
-        # locks by txid): tag the high bits with the member id
-        self.txid = (coord_tag << 48) | next(ClusterTxn._ids)
+        self.txid = (coord_tag << 56) | next(ClusterTxn._ids)
         self.snapshot_vc = np.asarray(snapshot_vc, np.int32)
         self.writeset: List[Effect] = []
         self.active = True
@@ -54,6 +59,13 @@ class ClusterNode:
         self.cfg = member.cfg
         self.dc_id = member.dc_id
         self._txns: Dict[int, ClusterTxn] = {}
+        #: fault-injection seam for the takeover suites (the analogue of
+        #: the reference's brutal_kill_nodes mid-stream,
+        #: /root/reference/test/utils/test_utils.erl:182-194):
+        #: "after_seq" = die between sequencing and the commit fan-out
+        #: (wedges the chain), "after_first_commit" = die mid-fan-out
+        #: (partial commit — takeover must finish it for atomicity)
+        self.failpoint: Optional[str] = None
         #: session floor: my own commits are in my snapshots even before
         #: the aggregated stable catches up (read-your-writes across
         #: transactions; owner reads wait out in-flight commits below the
@@ -236,25 +248,32 @@ class ClusterNode:
             self._abort_prepared(txn.txid, prepared)
             raise
         # one DC-wide timestamp + per-shard chains from the sequencer
-        ts, prev = self._seq(sorted(shards))
+        # (ledgered under the txid so takeover can find this txn)
+        ts, prev = self._seq(sorted(shards), txn.txid)
+        if self.failpoint == "after_seq":
+            import os
+            os._exit(137)
         commit_vc = txn.snapshot_vc.copy()
         commit_vc[self.dc_id] = ts
         vc_wire = [int(x) for x in commit_vc]
         prev_wire = {int(k): int(v) for k, v in prev.items()}
-        for owner in by_owner:
+        for i, owner in enumerate(by_owner):
             if owner is None:
                 self.member.m_commit(txn.txid, vc_wire, prev_wire)
             else:
                 self.member.peers[owner].call(
                     "m_commit", txn.txid, vc_wire, prev_wire
                 )
+            if i == 0 and self.failpoint == "after_first_commit":
+                import os
+                os._exit(137)
         np.maximum(self.session_vc, commit_vc, out=self.session_vc)
         return commit_vc
 
-    def _seq(self, shards):
+    def _seq(self, shards, txid: int):
         if self.member.seq is not None:
-            return self.member.seq.next_ts(shards)
-        ts, prev = self.member.peers[0].call("m_seq", list(shards))
+            return self.member.seq_ts(shards, txid)
+        ts, prev = self.member.peers[0].call("m_seq", list(shards), txid)
         return ts, {int(k): int(v) for k, v in prev.items()}
 
     def _abort_prepared(self, txid: int, owners) -> None:

@@ -24,15 +24,37 @@ and per-node stable-time gossip aggregates the DC's stable snapshot
 Coordinators (cluster/coordinator.py) run on any member and drive these
 handlers over the intra-DC RPC.
 
-Known limits vs the reference (documented, not hidden): a coordinator
-crash between sequencing and the commit fan-out wedges that shard chain
-(the reference recovers via riak_core takeover); member restart/rejoin
-re-runs boot rather than handing off live.
+Fault tolerance (the reference's supervised-coordinator/vnode-takeover
+story, /root/reference/src/clocksi_interactive_coord_sup.erl:44,
+/root/reference/src/antidote_sup.erl:57-158, exercised by
+/root/reference/test/multidc/multiple_dcs_node_failure_SUITE.erl:79-99):
+
+  * PREPARE LOG: with a ``log_dir``, every prepare/commit/abort and
+    every sequencer issue is appended to a durable ``prepare.wal`` next
+    to the shard WALs, so staged write-sets and the ts ledger survive a
+    member crash (the reference writes prepare records to
+    logging_vnode before commit for the same reason).
+  * TAKEOVER: a coordinator dying between sequencing and the commit
+    fan-out leaves a hole in a shard's ts chain.  Any member can call
+    ``resolve_wedged()``: the sequencer looks up the blocking txn,
+    polls every member for its outcome, and either completes the commit
+    (someone already applied it — atomicity) or aborts it everywhere
+    after a block barrier that shuts the door on a still-racing zombie
+    coordinator.  Decisions are recorded at the sequencer, so
+    re-resolution is idempotent.
+  * REJOIN: boot with ``recover=True`` on the same ``log_dir`` — the
+    store replays its WAL, the prepare log restores staged txns +
+    prepared locks + the sequencer ledger, and ``resolve_wedged()``
+    settles anything issued around the crash.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,23 +65,39 @@ from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import get_type
 from antidote_tpu.store.kv import freeze_key, key_to_shard, stable_min_of
 
+log = logging.getLogger(__name__)
+
 
 def owned_shards(cfg: AntidoteConfig, member_id: int, n_members: int):
     return [s for s in range(cfg.n_shards) if s % n_members == member_id]
 
 
+#: bound on remembered txn outcomes / ledger entries (GC floor)
+_LEDGER_CAP = 8192
+
+
 class Sequencer:
     """DC-wide commit-timestamp authority (member 0).
 
-    ``next_ts(shards)`` -> (ts, {shard: previous ts issued for it}) —
-    the per-shard chain lets owners apply own-DC commits contiguously."""
+    ``next_ts(shards, txid)`` -> (ts, {shard: previous ts issued for
+    it}) — the per-shard chain lets owners apply own-DC commits
+    contiguously.  The ledger (``issued`` + per-shard ``chain``) is what
+    takeover consults to identify the txn blocking a wedged chain."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counter = 0
         self.last_ts: Dict[int, int] = {}
+        #: ts -> (txid, [shards], {shard: prev}, monotonic issue time)
+        self.issued: "OrderedDict[int, tuple]" = OrderedDict()
+        #: shard -> [(ts, txid)] ascending (bounded)
+        self.chain: Dict[int, List[Tuple[int, int]]] = {}
+        #: txid -> ts (was this txn ever issued a ts? bounded like issued)
+        self.txid_index: "OrderedDict[int, int]" = OrderedDict()
+        #: txid -> takeover decision tuple (idempotent re-resolution)
+        self.resolutions: Dict[int, tuple] = {}
 
-    def next_ts(self, shards) -> Tuple[int, Dict[int, int]]:
+    def next_ts(self, shards, txid: int = 0) -> Tuple[int, Dict[int, int]]:
         with self._lock:
             self.counter += 1
             ts = self.counter
@@ -68,20 +106,70 @@ class Sequencer:
                 s = int(s)
                 prev[s] = self.last_ts.get(s, 0)
                 self.last_ts[s] = ts
+                self.chain.setdefault(s, []).append((ts, int(txid)))
+                if len(self.chain[s]) > _LEDGER_CAP:
+                    del self.chain[s][: -_LEDGER_CAP // 2]
+            self.issued[ts] = (int(txid), [int(s) for s in shards], prev,
+                               time.monotonic())
+            if txid:
+                self.txid_index[int(txid)] = ts
+            while len(self.issued) > _LEDGER_CAP:
+                self.issued.popitem(last=False)
+            while len(self.txid_index) > _LEDGER_CAP:
+                self.txid_index.popitem(last=False)
             return ts, prev
+
+    def restore_issue(self, ts: int, txid: int, shards, prev) -> None:
+        """Rebuild one ledger entry from the prepare log (recovery).
+        Restored entries carry issue-time 0 — older than any grace."""
+        with self._lock:
+            self.counter = max(self.counter, int(ts))
+            for s in shards:
+                s = int(s)
+                self.last_ts[s] = max(self.last_ts.get(s, 0), int(ts))
+                self.chain.setdefault(s, []).append((int(ts), int(txid)))
+            self.issued[int(ts)] = (
+                int(txid), [int(s) for s in shards],
+                {int(k): int(v) for k, v in prev.items()}, 0.0,
+            )
+            if txid:
+                self.txid_index[int(txid)] = int(ts)
+
+    def entry_after(self, shard: int, after_ts: int):
+        """The earliest issued (ts, txid) on ``shard`` with ts >
+        after_ts — the txn a wedged chain is waiting for."""
+        with self._lock:
+            for ts, txid in self.chain.get(int(shard), ()):
+                if ts > after_ts:
+                    return ts, txid
+            return None
 
 
 class ClusterMember:
     def __init__(self, cfg: AntidoteConfig, dc_id: int, member_id: int,
                  n_members: int, log_dir: Optional[str] = None,
-                 host: str = "127.0.0.1", shards=None):
+                 host: str = "127.0.0.1", shards=None,
+                 recover: bool = False):
         self.cfg = cfg
         self.dc_id = dc_id
         self.member_id = member_id
         self.n_members = n_members
         self.shards = set(shards if shards is not None
                           else owned_shards(cfg, member_id, n_members))
-        self.node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir)
+        if (n_members > 1
+                and self.shards != set(owned_shards(cfg, member_id,
+                                                    n_members))):
+            # takeover's 2PC safety check derives "which members own the
+            # txn's shards" from the modular layout (s % n_members); a
+            # deviating assignment would make it poll the wrong members'
+            # reachability and risk aborting behind a live owner's back
+            raise ValueError(
+                "multi-member DCs require the modular shard layout "
+                "(shard s owned by member s % n_members); custom "
+                "assignments would break coordinator-crash takeover's "
+                "involved-owner reachability check")
+        self.node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir,
+                                 recover=recover)
         #: sequencer lives on member 0 only
         self.seq = Sequencer() if member_id == 0 else None
         #: peer member_id -> RpcClient
@@ -107,13 +195,177 @@ class ClusterMember:
         }
         #: commit listeners (inter-DC egress seam): (effects, vc, origin)
         self.on_commit: List = []
+        #: txid -> (vc_wire, prev_wire) of applied commits (takeover polls)
+        self.committed_txns: "OrderedDict[int, tuple]" = OrderedDict()
+        #: txids barred from committing pending a takeover decision
+        self.blocked_txns: set = set()
+        #: txids resolved-aborted by takeover (bounded)
+        self.aborted_txns: "OrderedDict[int, bool]" = OrderedDict()
+        #: txid -> monotonic stage time (stale-prepare sweeps)
+        self.staged_at: Dict[int, float] = {}
+        #: durable prepare log (staged txns + sequencer ledger).  Honors
+        #: cfg.sync_log like the shard WALs: fsync-per-commit off by
+        #: default (the reference's sync_log=false stance — bounded loss
+        #: on power failure, none on process kill).
+        self._prep_wal = None
+        self._prep_dir = log_dir
+        self._prep_appends = 0
+        if log_dir is not None:
+            from antidote_tpu.log.wal import ShardWAL
+
+            os.makedirs(log_dir, exist_ok=True)
+            self._prep_wal = ShardWAL(os.path.join(log_dir, "prepare.wal"),
+                                      sync_on_commit=cfg.sync_log)
         self._seq_cache = 0
         self._seq_cache_at = 0.0
+        if recover:
+            pending = self._recover_prepare_log(log_dir)
+            # chain frontier = last own-DC ts applied per shard (the WAL
+            # replay rebuilt applied_vc; own lane only advances by applied
+            # own-DC commits, so its value IS the frontier)
+            for s in self.shards:
+                self.applied_ts[s] = int(
+                    self.node.store.applied_vc[s, self.dc_id])
+            self._replay_recovered_commits(pending)
         self.rpc = RpcServer(host=host)
         for name in ("m_read_values", "m_downstream", "m_prepare",
                      "m_commit", "m_abort", "m_clocks", "m_seq",
-                     "m_ready", "m_seq_counter"):
+                     "m_ready", "m_seq_counter", "m_txn_status",
+                     "m_block_txn", "m_forget_txn", "m_resolve_chain",
+                     "m_txn_sequenced", "m_resolve_stale_txn"):
             self.rpc.register(name, getattr(self, name))
+
+    # ------------------------------------------------------------------
+    # durable prepare log
+    # ------------------------------------------------------------------
+    def _prep_append(self, rec: dict) -> None:
+        if self._prep_wal is not None:
+            self._prep_wal.append(rec)
+            self._prep_wal.commit()
+            self._prep_appends += 1
+            if self._prep_appends >= _LEDGER_CAP * 2:
+                self._compact_prepare_log()
+
+    def _compact_prepare_log(self) -> None:
+        """Rewrite prepare.wal from live state: undecided preps + the
+        outcome/ledger tails.  Bounds disk use and recovery replay time
+        to O(in-flight + LEDGER_CAP), not O(all txns ever).  Caller must
+        hold (or be on a path that holds) the member lock; seq_ts also
+        serializes through it."""
+        from antidote_tpu.cluster.rpc import eff_to_wire
+        from antidote_tpu.log.wal import ShardWAL
+
+        with self._lock:
+            path = os.path.join(self._prep_dir, "prepare.wal")
+            tmp = path + ".tmp"
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            w = ShardWAL(tmp, sync_on_commit=False)
+            if self.seq is not None:
+                for ts, (txid, shards, prev, _) in self.seq.issued.items():
+                    w.append({"ev": "seq", "ts": int(ts), "txid": int(txid),
+                              "shards": shards,
+                              "prev": {int(k): int(v)
+                                       for k, v in prev.items()}})
+            for txid, (effects, _) in self.staged.items():
+                w.append({"ev": "prep", "txid": int(txid),
+                          "effs": [eff_to_wire(e) for e in effects]})
+            for txid, (vc, prev) in self.committed_txns.items():
+                w.append({"ev": "commit", "txid": int(txid), "vc": vc,
+                          "prev": {int(k): int(v) for k, v in prev.items()}})
+            for txid in self.aborted_txns:
+                w.append({"ev": "abort", "txid": int(txid)})
+            w.commit()
+            w.sync()
+            w.close()
+            self._prep_wal.close()
+            os.replace(tmp, path)
+            from antidote_tpu.log.wal import ShardWAL as _W
+
+            self._prep_wal = _W(path, sync_on_commit=self.cfg.sync_log)
+            self._prep_appends = 0
+
+    def _recover_prepare_log(self, log_dir: Optional[str]) -> list:
+        """Fold prepare.wal: staged-but-undecided txns come back with
+        their prepared locks; decided txns restore the outcome tables;
+        sequencer issues rebuild the ts ledger (member 0).
+
+        Returns the committed txns in log order WITHOUT dropping their
+        staged effects — a crash may have landed between the durable
+        commit record and the store apply, so the caller re-applies any
+        whose chain frontier shows them unapplied
+        (:meth:`_replay_recovered_commits`)."""
+        pending: list = []
+        if log_dir is None:
+            return pending
+        from antidote_tpu.log.wal import replay
+
+        path = os.path.join(log_dir, "prepare.wal")
+        if not os.path.exists(path):
+            return pending
+        for rec in replay(path):
+            ev = rec.get("ev")
+            txid = int(rec.get("txid", 0))
+            if ev == "prep":
+                effects = [eff_from_wire(w) for w in rec["effs"]]
+                keys = [(e.key, e.bucket) for e in effects]
+                self.staged[txid] = (effects, keys)
+                self.staged_at[txid] = 0.0  # older than any sweep grace
+                for dk in keys:
+                    self.prepared[dk] = txid
+            elif ev == "commit":
+                prev = {int(k): int(v) for k, v in rec["prev"].items()}
+                self.committed_txns[txid] = (rec["vc"], prev)
+                pending.append((txid, rec["vc"], prev))
+            elif ev == "abort":
+                self._drop_staged(txid)
+                self.aborted_txns[txid] = True
+            elif ev == "seq" and self.seq is not None:
+                self.seq.restore_issue(rec["ts"], txid, rec["shards"],
+                                       rec["prev"])
+        self._trim_ledgers()
+        return pending
+
+    def _replay_recovered_commits(self, pending: list) -> None:
+        """Finish commits whose durable decision preceded the crash but
+        whose effects never reached the store (still staged + frontier
+        below their ts).  Shards already at/past the ts are skipped —
+        their effects were applied and WAL-replayed."""
+        for txid, vc, prev in pending:
+            if txid not in self.staged:
+                continue  # applied pre-crash (or compacted as decided)
+            ts = int(np.asarray(vc)[self.dc_id])
+            effects, keys = self.staged.pop(txid)
+            by_shard: Dict[int, list] = {}
+            for eff in effects:
+                _, shard, _ = self.node.store.locate(
+                    eff.key, eff.type_name, eff.bucket
+                )
+                if shard in self.shards and self.applied_ts[shard] < ts:
+                    by_shard.setdefault(shard, []).append(eff)
+            cvc = np.asarray(vc, np.int32)
+            for shard, effs in by_shard.items():
+                self._chain_apply(shard, int(prev.get(shard, 0)), ts, effs,
+                                  cvc)
+            for dk in keys:
+                if self.prepared.get(dk) == txid:
+                    del self.prepared[dk]
+                self.last_commit[dk] = max(self.last_commit.get(dk, 0), ts)
+            self.staged_at.pop(txid, None)
+
+    def _drop_staged(self, txid: int) -> None:
+        self.staged_at.pop(txid, None)
+        effects_keys = self.staged.pop(txid, None)
+        if effects_keys is not None:
+            for dk in effects_keys[1]:
+                if self.prepared.get(dk) == txid:
+                    del self.prepared[dk]
+
+    def _trim_ledgers(self) -> None:
+        while len(self.committed_txns) > _LEDGER_CAP:
+            self.committed_txns.popitem(last=False)
+        while len(self.aborted_txns) > _LEDGER_CAP:
+            self.aborted_txns.popitem(last=False)
 
     # ------------------------------------------------------------------
     def connect(self, member_id: int, host: str, port: int) -> None:
@@ -141,10 +393,31 @@ class ClusterMember:
                 return True
         return False
 
-    def m_seq(self, shards) -> Tuple[int, Dict[int, int]]:
+    def m_seq(self, shards, txid: int = 0) -> Tuple[int, Dict[int, int]]:
+        return self.seq_ts(shards, txid)
+
+    def seq_ts(self, shards, txid: int = 0) -> Tuple[int, Dict[int, int]]:
+        """Issue a commit ts + per-shard prev chain, durably ledgered —
+        every coordinator (local or remote) must come through here so
+        takeover can find the txn behind any issued ts.  The member lock
+        serializes the ledger append with the other prepare-log writers
+        (the WAL is single-writer) and keeps 'seq' records in ts order."""
         assert self.seq is not None, "not the sequencer"
-        ts, prev = self.seq.next_ts(shards)
-        return ts, {int(k): int(v) for k, v in prev.items()}
+        with self._lock:
+            if txid and txid in self.seq.resolutions:
+                # the stale-prepare sweep already decided this txn's fate
+                # (coordinator stalled pre-seq, then woke up): refuse the
+                # ts — issuing one would open a chain hole that the sticky
+                # ts=0 resolution could never close
+                raise RuntimeError(
+                    f"abort: txn {txid} was resolved by takeover before "
+                    "sequencing")
+            ts, prev = self.seq.next_ts(shards, txid)
+            prev_wire = {int(k): int(v) for k, v in prev.items()}
+            self._prep_append({"ev": "seq", "ts": ts, "txid": int(txid),
+                               "shards": [int(s) for s in shards],
+                               "prev": prev_wire})
+        return ts, prev_wire
 
     def m_seq_counter(self) -> int:
         assert self.seq is not None, "not the sequencer"
@@ -276,31 +549,65 @@ class ClusterMember:
                     raise RuntimeError(
                         f"abort: certification conflict on {eff.key!r}"
                     )
+                # type-binding check HERE, not at apply: a key bound to a
+                # different CRDT type must fail as a clean prepare abort —
+                # discovered at commit it would poison the ts chain (the
+                # decision is durable before the apply).  The prepare lock
+                # then pins the binding until commit.
+                try:
+                    self.node.store.locate(eff.key, eff.type_name,
+                                           eff.bucket, create=False)
+                except TypeError as e:
+                    raise RuntimeError(f"abort: {e}") from e
             for eff in effects:
                 dk = (eff.key, eff.bucket)
                 self.prepared[dk] = txid
                 keys.append(dk)
             self.staged[txid] = (effects, keys)
+            self.staged_at[txid] = time.monotonic()
+            self._prep_append({"ev": "prep", "txid": int(txid),
+                               "effs": effs_wire})
         return True
 
     def m_abort(self, txid: int) -> bool:
         with self._lock:
-            effects_keys = self.staged.pop(txid, None)
-            if effects_keys is not None:
-                for dk in effects_keys[1]:
-                    if self.prepared.get(dk) == txid:
-                        del self.prepared[dk]
+            if txid in self.staged:
+                self._prep_append({"ev": "abort", "txid": int(txid)})
+            self._drop_staged(txid)
         return True
 
-    def m_commit(self, txid: int, commit_vc, prev_by_shard) -> bool:
+    def m_commit(self, txid: int, commit_vc, prev_by_shard,
+                 resolved: bool = False) -> bool:
         """Apply a staged txn at ts = commit_vc[own]; my shards' slices
-        apply in ts order via the sequencer's per-shard chain."""
+        apply in ts order via the sequencer's per-shard chain.
+
+        ``resolved`` marks a takeover-driven apply: it may pass a block
+        barrier.  A normal commit for a blocked or resolved-aborted txid
+        is refused — the zombie-coordinator door the takeover shut."""
         commit_vc = np.asarray(commit_vc, np.int32)
         ts = int(commit_vc[self.dc_id])
         with self._lock:
+            if txid in self.aborted_txns:
+                raise RuntimeError(
+                    f"abort: txn {txid} was resolved-aborted by takeover")
+            if not resolved and txid in self.blocked_txns:
+                raise RuntimeError(
+                    f"abort: txn {txid} is blocked pending takeover")
             effects, keys = self.staged.pop(txid, (None, None))
             if effects is None:
                 return True  # duplicate commit
+            self.staged_at.pop(txid, None)
+            self.blocked_txns.discard(txid)
+            self._prep_append({
+                "ev": "commit", "txid": int(txid),
+                "vc": [int(x) for x in commit_vc],
+                "prev": {int(k): int(v) for k, v in prev_by_shard.items()},
+            })
+            self.committed_txns[txid] = (
+                [int(x) for x in commit_vc],
+                {int(k): int(v) for k, v in prev_by_shard.items()},
+            )
+            self._trim_ledgers()
             by_shard: Dict[int, list] = {}
             for eff in effects:
                 _, shard, _ = self.node.store.locate(
@@ -316,6 +623,265 @@ class ClusterMember:
                     del self.prepared[dk]
                 self.last_commit[dk] = ts
         return True
+
+    # ------------------------------------------------------------------
+    # coordinator-crash takeover
+    # ------------------------------------------------------------------
+    def m_txn_status(self, txid: int) -> list:
+        """What this member knows about a txn (takeover poll)."""
+        with self._lock:
+            ent = self.committed_txns.get(txid)
+            if ent is not None:
+                return ["committed", ent[0],
+                        {int(k): int(v) for k, v in ent[1].items()}]
+            if txid in self.aborted_txns:
+                return ["aborted"]
+            if txid in self.staged:
+                return ["staged"]
+            return ["unknown"]
+
+    def m_block_txn(self, txid: int) -> list:
+        """Block barrier: unless already committed here, bar the txid
+        from committing until the takeover decision lands.  Returns the
+        pre-block status so the resolver can detect a commit that raced
+        in."""
+        with self._lock:
+            st = self.m_txn_status(txid)
+            if st[0] != "committed":
+                self.blocked_txns.add(txid)
+            return st
+
+    def m_forget_txn(self, txid: int, ts: int, shards, prev_by_shard
+                     ) -> bool:
+        """Apply a takeover ABORT decision: release the txn's staged
+        write-set + locks and close its hole in my owned shards' ts
+        chains (a no-op link, so successors drain)."""
+        with self._lock:
+            self.blocked_txns.discard(txid)
+            if txid not in self.aborted_txns:
+                self.aborted_txns[txid] = True
+                self._trim_ledgers()
+                if txid in self.staged:
+                    self._prep_append({"ev": "abort", "txid": int(txid)})
+                self._drop_staged(txid)
+            for s in shards:
+                s = int(s)
+                if s in self.shards and self.applied_ts[s] < int(ts):
+                    prev = int(prev_by_shard.get(str(s),
+                                                 prev_by_shard.get(s, 0)))
+                    self._chain_apply(s, prev, int(ts), [], None)
+        return True
+
+    def m_resolve_chain(self, shard: int, after_ts: int,
+                        grace_s: float = 0.0) -> Optional[list]:
+        """Takeover driver (sequencer member only): decide the fate of
+        the txn holding the earliest unapplied ts on ``shard``.
+
+        Decision rule: if ANY member applied it, the txn is committed —
+        return its commit VC + chains so stuck members can finish the
+        fan-out (atomicity).  Otherwise, after ``grace_s`` since issue,
+        block the txid at every reachable member (a late coordinator's
+        commit now refuses), re-check for a commit that raced in, and
+        failing that abort it everywhere.  Decisions are sticky."""
+        assert self.seq is not None, "m_resolve_chain runs on the sequencer"
+        ent = self.seq.entry_after(int(shard), int(after_ts))
+        if ent is None:
+            return None
+        ts, txid = ent
+        prior = self.seq.resolutions.get(txid)
+        if prior is not None:
+            if prior[0] == "abort" and int(prior[2]) != ts:
+                # the txn was stale-aborted pre-seq but a racing
+                # coordinator still got a ts in (defense in depth beside
+                # the seq_ts refusal): close the hole at the REAL ts
+                issued = self.seq.issued.get(ts)
+                if issued is not None:
+                    _, tx_shards, prev, _ = issued
+                    pw = {int(k): int(v) for k, v in prev.items()}
+                    self.m_forget_txn(txid, ts, tx_shards, pw)
+                    for mid, cli in self.peers.items():
+                        try:
+                            cli.call("m_forget_txn", txid, ts, tx_shards,
+                                     pw)
+                        except Exception as e:
+                            log.warning("takeover: hole-close of txn %d "
+                                        "at member %d failed: %s",
+                                        txid, mid, e)
+                return ["abort", int(txid), int(ts)]
+            return list(prior)
+        issued = self.seq.issued.get(ts)
+        if issued is None:
+            # ledger GC'd beneath a very old hole: nothing left to learn;
+            # treat as abort with an empty shard set is unsafe — refuse
+            raise RuntimeError(
+                f"ts {ts} missing from sequencer ledger (GC'd); manual "
+                "intervention required")
+        _, tx_shards, prev, t_issued = issued
+        dec = self._decide(txid, ts, tx_shards, prev, t_issued, grace_s)
+        if dec is not None and dec[0] != "wait":
+            self.seq.resolutions[txid] = tuple(dec)
+            if dec[0] == "commit":
+                # complete the dead coordinator's fan-out: every member
+                # holding the staged write-set applies it now
+                _, _, vc, prevw = dec
+                pw = {int(k): int(v) for k, v in prevw.items()}
+                try:
+                    self.m_commit(txid, vc, pw, resolved=True)
+                except Exception:
+                    log.warning("takeover: local completion of txn %d "
+                                "failed", txid, exc_info=True)
+                for mid, cli in self.peers.items():
+                    try:
+                        cli.call("m_commit", txid, vc, pw, True)
+                    except Exception as e:
+                        log.warning("takeover: completion of txn %d at "
+                                    "member %d failed: %s", txid, mid, e)
+        return dec
+
+    def _poll(self, method: str, txid: int) -> Dict[int, list]:
+        out = {self.member_id: getattr(self, method)(txid)}
+        for mid, cli in self.peers.items():
+            try:
+                out[mid] = cli.call(method, txid)
+            except Exception:
+                out[mid] = ["unreachable"]
+        return out
+
+    def _decide(self, txid, ts, tx_shards, prev, t_issued,
+                grace_s) -> Optional[list]:
+        """Takeover decision.  SAFETY RULE: a prepared participant may
+        only be aborted when every owner of the txn's shards is
+        reachable and reports not-committed — an unreachable owner may
+        have applied + WAL-logged the commit just before dying, and
+        aborting behind its back would diverge on rejoin (the classic
+        2PC blocking window; the reference rides it out the same way by
+        restarting the node, multiple_dcs_node_failure_SUITE).  The
+        block barrier shuts the door on a zombie coordinator racing the
+        abort."""
+        involved = {int(s) % self.n_members for s in tx_shards}
+        statuses = self._poll("m_txn_status", txid)
+        for st in statuses.values():
+            if st[0] == "committed":
+                return ["commit", int(txid), st[1], st[2]]
+        if any(statuses.get(mid, ["unreachable"])[0] == "unreachable"
+               for mid in involved):
+            return ["wait", int(txid)]  # blocking: owner may rejoin
+        if time.monotonic() - t_issued < grace_s:
+            return ["wait", int(txid)]
+        # block barrier everywhere, then re-check for a raced-in commit
+        blocked = self._poll("m_block_txn", txid)
+        for st in blocked.values():
+            if st[0] == "committed":
+                return ["commit", int(txid), st[1], st[2]]
+        if any(blocked.get(mid, ["unreachable"])[0] == "unreachable"
+               for mid in involved):
+            return ["wait", int(txid)]  # an owner died mid-barrier
+        prev_wire = {int(k): int(v) for k, v in prev.items()}
+        self.m_forget_txn(txid, ts, tx_shards, prev_wire)
+        for mid, cli in self.peers.items():
+            try:
+                cli.call("m_forget_txn", txid, ts, tx_shards, prev_wire)
+            except Exception as e:
+                log.warning("takeover: abort of txn %d at member %d "
+                            "failed: %s", txid, mid, e)
+        return ["abort", int(txid), int(ts)]
+
+    def m_txn_sequenced(self, txid: int) -> bool:
+        assert self.seq is not None
+        return int(txid) in self.seq.txid_index
+
+    def m_resolve_stale_txn(self, txid: int) -> list:
+        """Takeover for a txn whose coordinator died BEFORE sequencing:
+        its prepared locks would otherwise be held forever (no ts, so no
+        chain hole for m_resolve_chain to find).  Runs on the sequencer:
+        if the txid was never issued a ts — checked again after the
+        block barrier, so a racing coordinator that sequences late finds
+        its commit refused — abort it everywhere."""
+        assert self.seq is not None, "m_resolve_stale_txn runs on sequencer"
+        txid = int(txid)
+        prior = self.seq.resolutions.get(txid)
+        if prior is not None:
+            return list(prior)
+        if txid in self.seq.txid_index:
+            return ["sequenced", self.seq.txid_index[txid]]
+        blocked = self._poll("m_block_txn", txid)
+        for st in blocked.values():
+            if st[0] == "committed":
+                return ["commit", txid, st[1], st[2]]
+        if txid in self.seq.txid_index:
+            return ["sequenced", self.seq.txid_index[txid]]
+        self.m_forget_txn(txid, 0, [], {})
+        for cli in self.peers.values():
+            try:
+                cli.call("m_forget_txn", txid, 0, [], {})
+            except Exception:
+                pass
+        dec = ["abort", txid, 0]
+        self.seq.resolutions[txid] = tuple(dec)
+        return dec
+
+    def sweep_stale_prepared(self, grace_s: float = 30.0) -> int:
+        """Release prepared locks of txns staged longer than ``grace_s``
+        whose coordinator never reached the sequencer.  Sequenced txns
+        are left to :meth:`resolve_wedged` (the chain protocol owns
+        them).  Returns the number of txns resolved away."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [txid for txid, t in self.staged_at.items()
+                     if now - t >= grace_s]
+        n = 0
+        for txid in stale:
+            if self.seq is not None:
+                dec = self.m_resolve_stale_txn(txid)
+            else:
+                dec = self.peers[0].call("m_resolve_stale_txn", txid)
+            if dec[0] == "abort":
+                n += 1
+        return n
+
+    def resolve_wedged(self, grace_s: float = 0.0, max_rounds: int = 64
+                       ) -> int:
+        """Settle every unapplied issued ts on my owned shards via the
+        sequencer's takeover protocol.  Returns the number of decisions
+        applied.  Any member may call this (on a timer, on a stuck-read
+        timeout, or after a rejoin)."""
+        applied = 0
+        for _ in range(max_rounds):
+            progress = False
+            for s in sorted(self.shards):
+                frontier = int(self.applied_ts[s])
+                if self.seq is not None:
+                    dec = self.m_resolve_chain(s, frontier, grace_s)
+                else:
+                    dec = self.peers[0].call(
+                        "m_resolve_chain", s, frontier, grace_s)
+                if dec is None or dec[0] == "wait":
+                    continue
+                if dec[0] == "commit":
+                    _, txid, vc, prevw = dec
+                    self.m_commit(int(txid), vc, {
+                        int(k): int(v) for k, v in prevw.items()
+                    }, resolved=True)
+                elif dec[0] == "abort":
+                    _, txid, ts = dec
+                    # m_forget_txn already ran here via the broadcast;
+                    # re-apply locally in case we were unreachable then
+                    issued = None
+                    if self.seq is not None:
+                        issued = self.seq.issued.get(int(ts))
+                    if self.applied_ts[s] < int(ts):
+                        shards_ = issued[1] if issued else [s]
+                        prev_ = (issued[2] if issued
+                                 else {s: self.applied_ts[s]})
+                        self.m_forget_txn(int(txid), int(ts), shards_, {
+                            int(k): int(v) for k, v in prev_.items()
+                        })
+                if int(self.applied_ts[s]) > frontier:
+                    applied += 1
+                    progress = True
+            if not progress:
+                break
+        return applied
 
     def _chain_apply(self, shard: int, prev: int, ts: int, effects,
                      commit_vc) -> None:
@@ -333,12 +899,15 @@ class ClusterMember:
             self._apply_now(shard, nts, neffs, nvc)
 
     def _apply_now(self, shard: int, ts: int, effects, commit_vc) -> None:
-        self.node.store.apply_effects(
-            effects, [commit_vc] * len(effects), [self.dc_id] * len(effects)
-        )
+        if effects:  # a takeover no-op link just advances the frontier
+            self.node.store.apply_effects(
+                effects, [commit_vc] * len(effects),
+                [self.dc_id] * len(effects)
+            )
         self.applied_ts[shard] = ts
-        for listener in self.on_commit:
-            listener(effects, commit_vc, self.dc_id)
+        if effects:
+            for listener in self.on_commit:
+                listener(effects, commit_vc, self.dc_id)
 
     # ------------------------------------------------------------------
     # stable-time aggregation (meta_data_sender stable-time gossip)
@@ -376,6 +945,8 @@ class ClusterMember:
         self.rpc.close()
         for cli in self.peers.values():
             cli.close()
+        if self._prep_wal is not None:
+            self._prep_wal.close()
 
 
 def _wire_value(v):

@@ -74,6 +74,10 @@ class ProtocolServer:
             daemon_threads = True
             allow_reuse_address = True
             closing = False
+            # while the accept loop parks on the cap, excess connections
+            # must queue in the kernel listen backlog (ranch's shape) —
+            # the socketserver default of 5 would drop their SYNs
+            request_queue_size = max_connections
 
             def shutdown(self):
                 self.closing = True
