@@ -191,6 +191,7 @@ class TypedTable:
         self.used_rows = np.zeros((self.n_shards,), np.int64)
         self.next_seq = 1
         self._resolved_fns: Dict[bool, Any] = {}
+        self._resolved_flat_fns: Dict[bool, Any] = {}
         # host-tracked bound on |eff_a lane 0| — gates the i32 Pallas
         # counter-fold dispatch without any device readback (the r1 advisor
         # flagged the per-call jnp.abs().max() guard as a blocking sync)
@@ -434,6 +435,109 @@ class TypedTable:
         self._resolved_fns[pallas_counter] = fn
         return fn
 
+    @functools.cached_property
+    def _latest_resolved_flat_fn(self):
+        """Flat single-gather variant of :meth:`_latest_resolved_fn` —
+        no [P, M'] routing: index the tables by (shard, row) pairs in one
+        advanced-indexing gather.  Serving hot path on a single device;
+        mesh-sharded tables keep the routed layout (a flat gather across
+        the sharded axis would induce collectives)."""
+        ty, cfg = self.ty, self.cfg
+
+        @jax.jit
+        def fn(head, head_vc, ss, rr, read_vcs):
+            hvc = head_vc[ss, rr]
+            state = {f: x[ss, rr] for f, x in head.items()}
+            fresh = jnp.all(hvc <= read_vcs, axis=-1)
+            resolved = (
+                ty.resolve(cfg, state)
+                if ty.resolve_spec(cfg) is not None
+                else state
+            )
+            return resolved, fresh
+
+        return fn
+
+    def _read_resolved_flat_fn(self, pallas_counter: bool):
+        """Flat single-gather variant of :meth:`_read_resolved_fn`: the
+        same fused serving read (freshness + version select + ring fold +
+        resolution, one launch) with the batch as the leading axis — the
+        per-shard bodies run on pre-gathered rows via an identity index."""
+        cached = self._resolved_flat_fns.get(pallas_counter)
+        if cached is not None:
+            return cached
+        ty, cfg = self.ty, self.cfg
+        select = _shard_base_select_body(ty, cfg)
+
+        @jax.jit
+        def fn(head, head_vc, snap, snap_vc, snap_seq,
+               ops_a, ops_b, ops_vc, ops_origin, ss, rr, n_ops_flat,
+               read_vcs):
+            m = ss.shape[0]
+            idx = jnp.arange(m)
+            hvc = head_vc[ss, rr]
+            state_h = {f: x[ss, rr] for f, x in head.items()}
+            fresh = jnp.all(hvc <= read_vcs, axis=-1)
+            base_state, base_vc, complete = select(
+                {f: x[ss, rr] for f, x in snap.items()},
+                snap_vc[ss, rr], snap_seq[ss, rr], idx, read_vcs,
+            )
+            opa, opv = ops_a[ss, rr], ops_vc[ss, rr]
+            if pallas_counter:
+                from antidote_tpu.materializer import pallas_kernels as pk
+
+                k, d = opv.shape[1], opv.shape[2]
+                dcnt, applied = pk._counter_fold_call(
+                    opa[..., 0].astype(jnp.int32),
+                    opv, n_ops_flat, base_vc, read_vcs,
+                    256, not pk._on_tpu(),
+                )
+                state_f = {"cnt": base_state["cnt"] + dcnt.astype(jnp.int64)}
+            else:
+                state_f, applied = fold_mod.fold_batch(
+                    ty, cfg, base_state, opa, ops_b[ss, rr], opv,
+                    ops_origin[ss, rr], n_ops_flat, base_vc, read_vcs,
+                )
+            state = {
+                f: jnp.where(
+                    fresh.reshape(fresh.shape + (1,) * (x.ndim - 1)),
+                    state_h[f], x,
+                )
+                for f, x in state_f.items()
+            }
+            complete = complete | fresh
+            resolved = (
+                ty.resolve(cfg, state)
+                if ty.resolve_spec(cfg) is not None
+                else state
+            )
+            return resolved, fresh, complete
+
+        self._resolved_flat_fns[pallas_counter] = fn
+        return fn
+
+    def read_resolved_flat(self, shards, rows, read_vcs):
+        """One-launch flat serving read — no host routing, no unroute:
+        returns DEVICE arrays (resolved fields [M, ...], fresh [M],
+        complete [M]) in input order.  The single-device fast path;
+        callers on a mesh use :meth:`read_resolved_raw` (routed layout
+        keeps the gather shard-local)."""
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        read_vcs = np.asarray(read_vcs, np.int32)
+        if (read_vcs >= self.max_commit_vc).all():
+            resolved, fresh = self._latest_resolved_flat_fn(
+                self.head, self.head_vc, shards, rows, read_vcs
+            )
+            return resolved, fresh, fresh
+        n_ops_flat = self.n_ops[shards, rows]
+        fn = self._read_resolved_flat_fn(self._pallas_counter_ok())
+        return fn(
+            self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
+            self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
+            shards, rows, n_ops_flat, read_vcs,
+        )
+
     # ------------------------------------------------------------------
     # host routing helpers
     # ------------------------------------------------------------------
@@ -638,7 +742,17 @@ class TypedTable:
         [M]).  For types without ``resolve_spec`` the fields are the full
         materialized state.  Incomplete rows (read VC below retained device
         coverage) need the caller's log-replay fallback, as with
-        :meth:`read`."""
+        :meth:`read`.
+
+        Single-device tables serve through the flat path (one gather, no
+        [P, M'] routing/unrouting); mesh-sharded tables keep the routed
+        layout so gathers stay shard-local."""
+        if self.sharding is None:
+            resolved, fresh, complete = self.read_resolved_flat(
+                shards, rows, read_vcs
+            )
+            return ({f: np.asarray(x) for f, x in resolved.items()},
+                    np.asarray(fresh), np.asarray(complete))
         resolved, fresh, complete, pos = self.read_resolved_raw(
             shards, rows, read_vcs
         )

@@ -321,3 +321,50 @@ def test_resolved_view_ships_ovf_and_hatch_prevents_drops():
     assert sorted(vals[0]) == ["b", "c"]  # nothing dropped
     assert not any("dropped" in str(w.message) for w in rec)
     assert node.store.promotions >= 1
+
+
+def test_read_resolved_flat_matches_routed():
+    """The flat single-gather serving path (read_resolved_flat) and the
+    routed [P, M'] path must agree exactly — fresh, historical, and
+    absent keys, with and without the Pallas counter dispatch."""
+    d = 3
+    for tyname, use_pallas in (("set_aw", False), ("counter_pn", False),
+                               ("counter_pn", True)):
+        cfg = _mk_cfg(use_pallas=use_pallas)
+        ty = get_type(tyname)
+        table = TypedTable(ty, cfg)
+        if tyname == "set_aw":
+            _, mid = _populate_set(table, 10, d)
+        else:
+            clock = 0
+            aw = table.ops_a.shape[-1]
+            bw = table.ops_b.shape[-1]
+            for r in range(10):
+                for j in range(3):
+                    clock += 1
+                    vc = np.zeros(d, np.int32)
+                    vc[0] = clock
+                    ea = np.zeros((1, aw), np.int64)
+                    ea[0, 0] = j + 1
+                    table.append(
+                        np.asarray([r % table.n_shards]), np.asarray([r]),
+                        ea, np.zeros((1, bw), np.int32), vc[None, :],
+                        np.asarray([0], np.int32),
+                    )
+            mid = clock // 2
+        keys = np.asarray([0, 1, 2, 5, 9, 9, 3, 0], np.int64)
+        ss, rr = keys % table.n_shards, keys
+        for t in (mid, 10_000):
+            vcs = np.zeros((len(keys), d), np.int32)
+            vcs[:, 0] = t
+            flat_res, flat_fresh, flat_comp = table.read_resolved_flat(
+                ss, rr, vcs)
+            routed_out, routed_fresh, routed_comp = table.read_resolved(
+                ss, rr, vcs)
+            for f, x in routed_out.items():
+                np.testing.assert_array_equal(
+                    np.asarray(flat_res[f]), x, err_msg=(tyname, f, t))
+            np.testing.assert_array_equal(
+                np.asarray(flat_fresh), routed_fresh, err_msg=(tyname, t))
+            np.testing.assert_array_equal(
+                np.asarray(flat_comp), routed_comp, err_msg=(tyname, t))
