@@ -1,0 +1,33 @@
+# antidote_tpu node image — the env-var-driven single-node release the
+# reference ships (/root/reference/Dockerfiles/Dockerfile:3-13 shape).
+# For TPU hosts, base on a jax[tpu]-provisioned image instead and the
+# same entrypoint serves from the chip.
+FROM python:3.12-slim
+
+ENV PB_PORT=8087 \
+    PB_IP=0.0.0.0 \
+    METRICS_PORT=3001 \
+    DC_ID=0 \
+    SHARDS=16 \
+    MAX_DCS=8 \
+    DATA_DIR=/data \
+    JAX_PLATFORMS=cpu
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir "jax[cpu]" numpy msgpack
+
+WORKDIR /opt/antidote_tpu
+COPY antidote_tpu ./antidote_tpu
+# build the native WAL + router once at image build
+RUN python -c "from antidote_tpu.log.wal import _load_lib; assert _load_lib()" \
+    && python -c "from antidote_tpu.store.router import shard_batch; shard_batch(['k'], ['b'], 4)"
+
+VOLUME /data
+EXPOSE 8087 3001
+
+ENTRYPOINT ["sh", "-c", "exec python -m antidote_tpu.console serve \
+    --host ${PB_IP} --port ${PB_PORT} --metrics-port ${METRICS_PORT} \
+    --dc-id ${DC_ID} --shards ${SHARDS} --max-dcs ${MAX_DCS} \
+    --log-dir ${DATA_DIR}"]

@@ -85,6 +85,14 @@ def main(argv=None) -> int:
                         lambda name: setattr(node, "failpoint", name) or True)
     member.rpc.register("ctl_resolve",
                         lambda grace=0.0: member.resolve_wedged(grace))
+    # membership/ops surface for console.py (ringready/cluster-status/
+    # cluster-sweep — antidote_console.erl parity)
+    member.rpc.register("ctl_sweep",
+                        lambda grace=30.0: member.sweep_stale_prepared(grace))
+    member.rpc.register("ctl_ready_all",
+                        lambda: {str(k): bool(v)
+                                 for k, v in node.check_ready().items()})
+    member.rpc.register("ctl_status", lambda: node.status(include_ready=True))
 
     print(json.dumps({
         "rpc": list(member.address),

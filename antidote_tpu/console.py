@@ -160,6 +160,46 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def _member_rpc(args):
+    from antidote_tpu.cluster.rpc import RpcClient
+
+    host, port = args.rpc.rsplit(":", 1)
+    return RpcClient(host, int(port))
+
+
+def cmd_ringready(args) -> int:
+    """All members of the DC up and answering (the riak_core ringready
+    probe, /root/reference/src/antidote_console.erl:34-50)."""
+    cli = _member_rpc(args)
+    probes = cli.call("ctl_ready_all")
+    cli.close()
+    print(json.dumps(probes))
+    return 0 if all(probes.values()) else 1
+
+
+def cmd_cluster_status(args) -> int:
+    cli = _member_rpc(args)
+    print(json.dumps(cli.call("ctl_status")))
+    cli.close()
+    return 0
+
+
+def cmd_cluster_resolve(args) -> int:
+    cli = _member_rpc(args)
+    n = cli.call("ctl_resolve", args.grace)
+    cli.close()
+    print(json.dumps({"resolved": n}))
+    return 0
+
+
+def cmd_cluster_sweep(args) -> int:
+    cli = _member_rpc(args)
+    n = cli.call("ctl_sweep", args.grace)
+    cli.close()
+    print(json.dumps({"swept": n}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="antidote_tpu.console")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -199,6 +239,29 @@ def main(argv=None) -> int:
     ins = sub.add_parser("inspect", help="offline WAL inspection")
     ins.add_argument("--log-dir", required=True)
     ins.set_defaults(fn=cmd_inspect)
+
+    # cluster membership/ops commands against a member's control RPC
+    # (antidote_console staged_join/down/ringready,
+    # /root/reference/src/antidote_console.erl:34-50; rejoin a crashed
+    # member with `python -m antidote_tpu.cluster.boot ... --recover`)
+    for name, fn, hlp in (
+        ("ringready", cmd_ringready,
+         "all cluster members up and answering (riak_core ringready)"),
+        ("cluster-status", cmd_cluster_status,
+         "member topology, owned shards, stable VC"),
+        ("cluster-resolve", cmd_cluster_resolve,
+         "takeover: settle wedged commit chains (dead coordinator)"),
+        ("cluster-sweep", cmd_cluster_sweep,
+         "release prepared locks of never-sequenced dead txns"),
+    ):
+        p = sub.add_parser(name, help=hlp)
+        p.add_argument("--rpc", required=True,
+                       help="member control RPC as host:port")
+        if name == "cluster-resolve":
+            p.add_argument("--grace", type=float, default=0.0)
+        if name == "cluster-sweep":
+            p.add_argument("--grace", type=float, default=30.0)
+        p.set_defaults(fn=fn)
 
     args = ap.parse_args(argv)
     return args.fn(args)

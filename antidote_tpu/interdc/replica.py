@@ -20,6 +20,7 @@ Combines the reference's egress and ingress pipelines (SURVEY §3.4):
 from __future__ import annotations
 
 import collections
+import itertools
 import time
 from typing import Dict, List, Tuple
 
@@ -426,41 +427,78 @@ class DCReplica:
     def _drain_gates(self) -> None:
         """Apply every gated txn whose dependencies are satisfied; loop
         until no queue makes progress (process_all_queues,
-        /root/reference/src/inter_dc_dep_vnode.erl:96-103)."""
-        progressed = True
-        while progressed:
-            progressed = False
-            for (origin, shard), q in self.gate.items():
-                while q:
-                    msg = q[0]
-                    if msg.is_ping:
-                        self._advance_clock(shard, origin, msg.timestamp)
-                        q.popleft()
+        /root/reference/src/inter_dc_dep_vnode.erl:96-103).
+
+        Ready txns are BATCHED into one ``apply_effects`` device launch
+        per drain round: readiness cascades are evaluated against a
+        simulated clock copy, and the real partition clocks only advance
+        after the whole batch applied (the stable snapshot must never
+        dominate unapplied ops — including ping advances, which are
+        deferred the same way so a ping queued behind a txn cannot claim
+        its ts early)."""
+        store = self.node.store
+        while True:
+            sim = store.applied_vc.copy()
+            batch: list = []  # ready txns, dependency-respecting order
+            advances: list = []  # (shard, origin, ts) after apply
+            taken: Dict[tuple, int] = {}  # gate key -> msgs consumed
+            progressed = True
+            while progressed:
+                progressed = False
+                for gk, q in self.gate.items():
+                    origin, shard = gk
+                    i = taken.get(gk, 0)
+                    for msg in itertools.islice(q, i, None):
+                        if msg.is_ping:
+                            ts = msg.timestamp
+                            if sim[shard, origin] < ts:
+                                sim[shard, origin] = ts
+                                advances.append((shard, origin, ts))
+                            i += 1
+                            progressed = True
+                            continue
+                        # duplicate suppression: per-chain origin
+                        # timestamps are strictly monotone, and the chain
+                        # clock only advances past ts once the txn
+                        # carrying ts was applied (or a catch-up replayed
+                        # it) — so ts ≤ clock ⟺ already applied.  Makes
+                        # re-delivery (restart catch-up from a
+                        # conservative opid) idempotent.
+                        ts = int(msg.commit_vc[origin])
+                        if ts <= int(sim[shard, origin]):
+                            i += 1
+                            progressed = True
+                            continue
+                        local = sim[shard].copy()
+                        local[origin] = 0
+                        if not (local >= msg.snapshot_vc).all():
+                            break
+                        batch.append((msg, origin))
+                        sim[shard, origin] = ts
+                        advances.append((shard, origin, ts))
+                        i += 1
                         progressed = True
-                        continue
-                    # duplicate suppression: per-chain origin timestamps are
-                    # strictly monotone, and the chain clock only advances
-                    # past ts once the txn carrying ts was applied (or a
-                    # catch-up replayed it) — so ts ≤ clock ⟺ already
-                    # applied.  Makes re-delivery (restart catch-up from a
-                    # conservative opid) idempotent.
-                    if (int(msg.commit_vc[origin])
-                            <= int(self.node.store.applied_vc[shard, origin])):
-                        q.popleft()
-                        progressed = True
-                        continue
-                    local = self.node.store.applied_vc[shard].copy()
-                    local[origin] = 0
-                    dep_ok = (local >= msg.snapshot_vc).all()
-                    if not dep_ok:
-                        break
-                    self.node.txm.apply_remote(
-                        msg.effects, msg.commit_vc, origin
-                    )
-                    self._advance_clock(shard, origin,
-                                        int(msg.commit_vc[origin]))
+                    taken[gk] = i
+            if not batch and not advances:
+                return
+            if batch:
+                effects, vcs, origins = [], [], []
+                for msg, origin in batch:
+                    vc = np.asarray(msg.commit_vc, np.int32)
+                    for eff in msg.effects:
+                        effects.append(eff)
+                        vcs.append(vc)
+                        origins.append(origin)
+                # messages are consumed from the queues only AFTER the
+                # apply succeeds — an exception leaves everything queued
+                # for the next drain instead of silently dropping txns
+                store.apply_effects(effects, vcs, origins)
+            for gk, n in taken.items():
+                q = self.gate[gk]
+                for _ in range(n):
                     q.popleft()
-                    progressed = True
+            for shard, origin, ts in advances:
+                self._advance_clock(shard, origin, ts)
 
     def _advance_clock(self, shard: int, origin: int, ts: int) -> None:
         vc = self.node.store.applied_vc

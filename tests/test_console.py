@@ -125,3 +125,42 @@ def test_console_inspect(tmp_path, cfg, capsys):
     total = sum(s["records"] for s in out.values())
     assert total == 2
     assert any("counter_pn" in s["records_by_type"] for s in out.values())
+
+
+def test_console_cluster_commands(tmp_path, capsys):
+    """ringready / cluster-status / cluster-resolve / cluster-sweep
+    against a live 2-member DC (antidote_console staged-ops parity,
+    /root/reference/src/antidote_console.erl:34-50)."""
+    import json as _json
+
+    from antidote_tpu.console import main as console_main
+    from tests.test_cluster_processes import _spawn_duo
+
+    env, spawned, infos = _spawn_duo(tmp_path)
+    try:
+        rpc = "{}:{}".format(*infos[0]["rpc"])
+        assert console_main(["ringready", "--rpc", rpc]) == 0
+        probes = _json.loads(capsys.readouterr().out.strip())
+        assert all(probes.values()) and len(probes) == 2
+        assert console_main(["cluster-status", "--rpc", rpc]) == 0
+        st = _json.loads(capsys.readouterr().out.strip())
+        assert st["members"] == 2 and st["owned_shards"] == [0, 2]
+        assert console_main(["cluster-resolve", "--rpc", rpc]) == 0
+        assert _json.loads(capsys.readouterr().out.strip()) == {"resolved": 0}
+        assert console_main(["cluster-sweep", "--rpc", rpc,
+                             "--grace", "0"]) == 0
+        assert _json.loads(capsys.readouterr().out.strip()) == {"swept": 0}
+        # a dead member flips ringready
+        spawned[1].kill()
+        spawned[1].wait(timeout=10)
+        assert console_main(["ringready", "--rpc", rpc]) == 1
+        probes = _json.loads(capsys.readouterr().out.strip())
+        assert not all(probes.values())
+    finally:
+        for p in spawned:
+            p.terminate()
+        for p in spawned:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()

@@ -185,3 +185,75 @@ def test_many_keys_across_shards(node):
     objs = [(i, "counter_pn", "b") for i in range(40)]
     vals, _ = node.read_objects(objs, clock=vc)
     assert vals == [i for i in range(40)]
+
+
+# ---------------------------------------------------------------------------
+# decoded-value cache (the host-level snapshot_cache analogue)
+# ---------------------------------------------------------------------------
+def test_value_cache_invalidation_on_write(node):
+    """Repeated latest reads serve from the decoded-value cache; every
+    write to the key (or to a map's field/membership) invalidates it —
+    reads must never see a stale cached value."""
+    node.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    for expect in (1, 2, 3):
+        vals, _ = node.read_objects([("c", "counter_pn", "b")])
+        assert vals[0] == expect
+        vals, _ = node.read_objects([("c", "counter_pn", "b")])  # cached
+        assert vals[0] == expect
+        node.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    # composite: field write invalidates the assembled-map entry
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("k", "counter_pn"): ("increment", 5)}))])
+    vals, _ = node.read_objects([("m", "map_rr", "b")])
+    assert vals[0][("k", "counter_pn")] == 5
+    vals, _ = node.read_objects([("m", "map_rr", "b")])  # cached
+    assert vals[0][("k", "counter_pn")] == 5
+    node.update_objects([("m", "map_rr", "b", ("update", {
+        ("k", "counter_pn"): ("increment", 2)}))])
+    vals, _ = node.read_objects([("m", "map_rr", "b")])
+    assert vals[0][("k", "counter_pn")] == 7
+
+
+def test_value_cache_historical_reads_bypass(node):
+    """A cached latest value must not serve an open txn's older
+    snapshot (the clock= parameter is only a causal LOWER bound — the
+    snapshot-isolation case is a txn opened before later commits)."""
+    node.update_objects([("s", "set_aw", "b", ("add", "x"))])
+    txn = node.start_transaction()  # snapshot: only x
+    node.update_objects([("s", "set_aw", "b", ("add", "y"))])
+    vals, _ = node.read_objects([("s", "set_aw", "b")])
+    assert vals[0] == ["x", "y"]  # fills the cache at latest
+    vals = node.read_objects([("s", "set_aw", "b")], txn)
+    assert vals[0] == ["x"], "old snapshot served the newer cached value"
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("s", "set_aw", "b")])
+    assert vals[0] == ["x", "y"]
+
+
+def test_value_cache_client_mutation_isolated(node):
+    """Mutating a returned container must not poison the cache."""
+    node.update_objects([("s2", "set_aw", "b", ("add_all", ["a", "b"]))])
+    vals, _ = node.read_objects([("s2", "set_aw", "b")])
+    vals[0].append("EVIL")
+    vals2, _ = node.read_objects([("s2", "set_aw", "b")])
+    assert vals2[0] == ["a", "b"]
+    node.update_objects([("m2", "map_rr", "b", ("update", {
+        ("t", "set_aw"): ("add", "z")}))])
+    mv, _ = node.read_objects([("m2", "map_rr", "b")])
+    mv[0][("t", "set_aw")].append("EVIL")
+    mv[0][("extra", "counter_pn")] = 666
+    mv2, _ = node.read_objects([("m2", "map_rr", "b")])
+    assert mv2[0] == {("t", "set_aw"): ["z"]}
+
+
+def test_value_cache_nested_map_mutation_isolated(node):
+    """Deep containers: mutating an INNER dict of a nested map must not
+    poison the cache (the copy is recursive, not one level)."""
+    node.update_objects([("mm", "map_rr", "b", ("update", {
+        ("n", "map_rr"): ("update", {("c", "counter_pn"): ("increment", 1)}),
+    }))])
+    v, _ = node.read_objects([("mm", "map_rr", "b")])
+    assert v[0][("n", "map_rr")][("c", "counter_pn")] == 1
+    v[0][("n", "map_rr")][("c", "counter_pn")] = 999
+    v2, _ = node.read_objects([("mm", "map_rr", "b")])
+    assert v2[0][("n", "map_rr")][("c", "counter_pn")] == 1
