@@ -62,4 +62,23 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
 
     replica.safe_time = safe_time
     member.on_commit.append(replica._on_local_commit)
+
+    def transfer(payload):
+        """Inter-DC bcounter rights requests land on member 0's endpoint
+        (bare-dc fabric id); route to the key's owner, whose coordinator
+        commits the grant through the DC sequencer."""
+        from antidote_tpu.store.kv import freeze_key, key_to_shard
+
+        key = freeze_key(payload["key"])
+        bucket = payload["bucket"]
+        shard = key_to_shard(key, bucket, member.cfg.n_shards)
+        owner = shard % member.n_members
+        if owner == member.member_id:
+            return member.m_process_transfer(
+                key, bucket, payload["amount"], payload["to_dc"])
+        return member.peers[owner].call(
+            "m_process_transfer", key, bucket, payload["amount"],
+            payload["to_dc"])
+
+    replica.transfer_handler = transfer
     return replica

@@ -85,14 +85,25 @@ class ClusterNode:
         )
 
     # ------------------------------------------------------------------
-    def start_transaction(self, clock=None, props=None) -> ClusterTxn:
+    def _snapshot(self) -> np.ndarray:
         snap = np.maximum(self.member.stable_vc(), self.session_vc)
+        if self.member.node.txm.protocol == "gr":
+            # GentleRain on a clustered DC: the snapshot is the scalar
+            # GST — the min lane of the aggregated cluster stable vector
+            # (cure:gr_snapshot_obtain via get_scalar_stable_time,
+            # /root/reference/src/dc_utilities.erl:294-317)
+            gst = int(snap.min())
+            snap = np.full_like(snap, gst)
         # freshest own-lane view (cached sequencer frontier): blind writes
         # certify against recent commits instead of spuriously aborting,
         # and reads wait out in-flight commits at the owners (the
         # reference's check_clock freshness wait does the same job)
         snap[self.dc_id] = max(int(snap[self.dc_id]),
                                self.member._seq_counter())
+        return snap
+
+    def start_transaction(self, clock=None, props=None) -> ClusterTxn:
+        snap = self._snapshot()
         if clock is not None:
             import time as _t
 
@@ -105,7 +116,7 @@ class ClusterNode:
                 # iteration bound is ~20 s of real time, not microseconds
                 _t.sleep(0.002)
                 self.member.refresh_peer_clocks()
-                snap = np.maximum(self.member.stable_vc(), self.session_vc)
+                snap = self._snapshot()
             else:
                 raise TimeoutError(
                     f"stable snapshot {snap} never reached client clock "
@@ -127,12 +138,6 @@ class ClusterNode:
 
     def _read(self, objects, txn: ClusterTxn) -> list:
         assert txn.active
-        if txn.writeset:
-            raise NotImplementedError(
-                "cluster coordinators serve reads-after-writes from the "
-                "owners at commit time; read-your-own-writes within one "
-                "open cluster txn is not supported yet"
-            )
         out: List[Any] = [None] * len(objects)
         by_owner: Dict[Optional[int], list] = {}
         for i, (key, t, bucket) in enumerate(objects):
@@ -140,12 +145,24 @@ class ClusterNode:
             by_owner.setdefault(self._owner_of(key, bucket), []).append(
                 (i, (key, t, bucket))
             )
+        # read-your-writes: ship the txn's own pending effects per object
+        # to the owners, who overlay them on the snapshot state
+        # (materialize_eager at the owner; clocksi_interactive_coord
+        # apply_tx_updates_to_snapshot,
+        # /root/reference/src/clocksi_interactive_coord.erl:882-894)
+        pend_by_dk: Dict[tuple, list] = {}
+        if txn.writeset:
+            for eff in txn.writeset:
+                pend_by_dk.setdefault((eff.key, eff.bucket), []).append(
+                    eff_to_wire(eff))
         for owner, items in by_owner.items():
             objs = [o for _, o in items]
+            overlays = [pend_by_dk.get((k, b)) for (k, _t, b) in objs] \
+                if pend_by_dk else None
             if owner is None:
                 vals = [
                     unwire_value(v) for v in self.member.m_read_values(
-                        objs, txn.snapshot_vc
+                        objs, txn.snapshot_vc, overlays
                     )
                 ]
             else:
@@ -153,7 +170,7 @@ class ClusterNode:
                     unwire_value(v)
                     for v in self.member.peers[owner].call(
                         "m_read_values", objs,
-                        [int(x) for x in txn.snapshot_vc],
+                        [int(x) for x in txn.snapshot_vc], overlays,
                     )
                 ]
             for (i, _), v in zip(items, vals):
@@ -190,27 +207,56 @@ class ClusterNode:
                 ):
                     self._update([sub], txn)
                 continue
-            if ty.require_state_downstream(op):
-                # the owner generates against its replica's state
+            # counter_b decrements/transfers are escrow-guarded at the
+            # key's owner even though their downstream is stateless
+            guarded_b = (type_name == "counter_b"
+                         and op[0] in ("decrement", "transfer"))
+            if ty.require_state_downstream(op) or guarded_b:
+                # the owner generates against its replica's state, with
+                # the txn's own pending effects for the key overlaid
+                # (observed-remove must see same-txn adds)
                 owner = self._owner_of(key, bucket)
-                if owner is None:
-                    wires = self.member.m_downstream(
-                        key, type_name, bucket, op, txn.snapshot_vc
-                    )
-                else:
-                    wires = self.member.peers[owner].call(
-                        "m_downstream", key, type_name, bucket, op,
-                        [int(x) for x in txn.snapshot_vc],
-                    )
+                overlay = [eff_to_wire(e) for e in txn.writeset
+                           if e.key == key and e.bucket == bucket] or None
+                try:
+                    if owner is None:
+                        wires = self.member.m_downstream(
+                            key, type_name, bucket, op, txn.snapshot_vc,
+                            overlay,
+                        )
+                    else:
+                        wires = self.member.peers[owner].call(
+                            "m_downstream", key, type_name, bucket, op,
+                            [int(x) for x in txn.snapshot_vc], overlay,
+                        )
+                except RuntimeError as e:
+                    if "abort" in str(e):
+                        self.abort_transaction(txn)
+                        raise AbortError(str(e)) from e
+                    raise
                 from antidote_tpu.cluster.rpc import eff_from_wire
 
-                txn.writeset.extend(eff_from_wire(w) for w in wires)
+                seq = self._pend_count(txn, key, bucket)
+                for w in wires:
+                    eff = eff_from_wire(w)
+                    eff.eff_a, eff.eff_b = ty.stamp_op_seq(
+                        eff.eff_a, eff.eff_b, seq)
+                    seq += 1
+                    txn.writeset.append(eff)
             else:
                 blobs = self.member.node.store.blobs
+                seq = self._pend_count(txn, key, bucket)
                 for a, b, refs in ty.downstream(op, None, blobs, self.cfg):
+                    a, b = ty.stamp_op_seq(a, b, seq)
+                    seq += 1
                     txn.writeset.append(
                         Effect(key, type_name, bucket, a, b, refs)
                     )
+
+    @staticmethod
+    def _pend_count(txn: ClusterTxn, key, bucket) -> int:
+        return sum(1 for e in txn.writeset
+                   if e.key == key and e.bucket == bucket)
 
     # ------------------------------------------------------------------
     def commit_transaction(self, txn: ClusterTxn) -> np.ndarray:

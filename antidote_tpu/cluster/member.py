@@ -149,7 +149,7 @@ class ClusterMember:
     def __init__(self, cfg: AntidoteConfig, dc_id: int, member_id: int,
                  n_members: int, log_dir: Optional[str] = None,
                  host: str = "127.0.0.1", shards=None,
-                 recover: bool = False):
+                 recover: bool = False, meta=None):
         self.cfg = cfg
         self.dc_id = dc_id
         self.member_id = member_id
@@ -169,7 +169,8 @@ class ClusterMember:
                 "assignments would break coordinator-crash takeover's "
                 "involved-owner reachability check")
         self.node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir,
-                                 recover=recover)
+                                 recover=recover, meta=meta)
+        self._coordinator = None
         #: sequencer lives on member 0 only
         self.seq = Sequencer() if member_id == 0 else None
         #: peer member_id -> RpcClient
@@ -203,6 +204,10 @@ class ClusterMember:
         self.aborted_txns: "OrderedDict[int, bool]" = OrderedDict()
         #: txid -> monotonic stage time (stale-prepare sweeps)
         self.staged_at: Dict[int, float] = {}
+        #: (key, bucket, read_vc bytes) -> (folded state, n, prefix digest)
+        #: — incremental overlay folds: a txn's Nth same-key overlay call
+        #: folds only the new effects, not the whole prefix again
+        self._overlay_fold_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         #: durable prepare log (staged txns + sequencer ledger).  Honors
         #: cfg.sync_log like the shard WALs: fsync-per-commit off by
         #: default (the reference's sync_log=false stance — bounded loss
@@ -232,8 +237,18 @@ class ClusterMember:
                      "m_commit", "m_abort", "m_clocks", "m_seq",
                      "m_ready", "m_seq_counter", "m_txn_status",
                      "m_block_txn", "m_forget_txn", "m_resolve_chain",
-                     "m_txn_sequenced", "m_resolve_stale_txn"):
+                     "m_txn_sequenced", "m_resolve_stale_txn",
+                     "m_process_transfer"):
             self.rpc.register(name, getattr(self, name))
+
+    def coordinator(self):
+        """This member's own transaction coordinator (any member may
+        coordinate; lazily built to avoid an import cycle)."""
+        if self._coordinator is None:
+            from antidote_tpu.cluster.coordinator import ClusterNode
+
+            self._coordinator = ClusterNode(self)
+        return self._coordinator
 
     # ------------------------------------------------------------------
     # durable prepare log
@@ -267,9 +282,12 @@ class ClusterMember:
                               "shards": shards,
                               "prev": {int(k): int(v)
                                        for k, v in prev.items()}})
-            for txid, (effects, _) in self.staged.items():
-                w.append({"ev": "prep", "txid": int(txid),
-                          "effs": [eff_to_wire(e) for e in effects]})
+            for txid, (effects, _, snap_own) in self.staged.items():
+                rec = {"ev": "prep", "txid": int(txid),
+                       "effs": [eff_to_wire(e) for e in effects]}
+                if snap_own is not None:
+                    rec["snap"] = int(snap_own)
+                w.append(rec)
             for txid, (vc, prev) in self.committed_txns.items():
                 w.append({"ev": "commit", "txid": int(txid), "vc": vc,
                           "prev": {int(k): int(v) for k, v in prev.items()}})
@@ -309,7 +327,9 @@ class ClusterMember:
             if ev == "prep":
                 effects = [eff_from_wire(w) for w in rec["effs"]]
                 keys = [(e.key, e.bucket) for e in effects]
-                self.staged[txid] = (effects, keys)
+                snap = rec.get("snap")
+                self.staged[txid] = (effects, keys,
+                                     None if snap is None else int(snap))
                 self.staged_at[txid] = 0.0  # older than any sweep grace
                 for dk in keys:
                     self.prepared[dk] = txid
@@ -335,7 +355,15 @@ class ClusterMember:
             if txid not in self.staged:
                 continue  # applied pre-crash (or compacted as decided)
             ts = int(np.asarray(vc)[self.dc_id])
-            effects, keys = self.staged.pop(txid)
+            effects, keys, snap_own = self.staged.pop(txid)
+            # snap_own None = legacy record predating overlay stamping:
+            # its effects carry no tentative dots, nothing to rewrite
+            if snap_own is not None and snap_own + 1 != ts:
+                for eff in effects:
+                    ty_e = get_type(eff.type_name)
+                    eff.eff_a, eff.eff_b = ty_e.restamp_own_dots(
+                        self.cfg, eff.eff_a, eff.eff_b, self.dc_id,
+                        snap_own + 1, ts)
             by_shard: Dict[int, list] = {}
             for eff in effects:
                 _, shard, _ = self.node.store.locate(
@@ -463,9 +491,15 @@ class ClusterMember:
             if vc[s, own] < ctr:
                 vc[s, own] = ctr
 
-    def m_read_values(self, objects, read_vc) -> list:
+    def m_read_values(self, objects, read_vc, overlays=None) -> list:
         """Owner read: values at ``read_vc`` for my keys (the serving
         path: store.read_values -> read_resolved).
+
+        ``overlays`` (aligned with ``objects``; None entries = plain)
+        carries a coordinator txn's own pending effects for each object —
+        read-your-writes in open cluster transactions: the owner reads
+        the base state at the snapshot, folds the txn's effects eagerly
+        (materialize_eager), and returns the overlaid value.
 
         Before reading, each involved shard waits until its own-lane
         clock can safely claim ``read_vc[own]`` — an in-flight commit
@@ -482,8 +516,90 @@ class ClusterMember:
         for s in shards:
             self._wait_read_safe(s, want)
         with self._lock:
-            vals = self.node.store.read_values(objs, read_vc)
+            if not overlays or not any(overlays):
+                vals = self.node.store.read_values(objs, read_vc)
+            else:
+                vals = self._read_values_overlaid(objs, read_vc, overlays)
         return [_wire_value(v) for v in vals]
+
+    @staticmethod
+    def _overlay_digest(wires) -> int:
+        return hash(tuple((w["a"], w["eb"]) for w in wires))
+
+    def _overlay_state(self, key, type_name, bucket, state, read_vc,
+                       overlay) -> dict:
+        """Fold a txn's pending effect wires onto a host state copy
+        (materialize_eager at the owner).  The tentative own-lane stamp
+        is read_vc[own]+1 = snapshot+1 — the same value m_commit's
+        restamp rewrites to the real commit ts.
+
+        Folds are cached per (key, bucket, read VC) with a prefix
+        fingerprint: a coordinator re-sending its txn's growing effect
+        list only pays for the NEW effects (O(N) total, not O(N^2)); a
+        different txn's overlay on the same key misses the fingerprint
+        and rebuilds."""
+        import jax
+        import jax.numpy as jnp
+
+        from antidote_tpu.store.kv import _pad_lane
+        from antidote_tpu.txn.manager import _jitted_apply
+
+        store = self.node.store
+        ty = get_type(type_name)
+        ent = store.locate(key, type_name, bucket, create=False)
+        cfg_k = store.table(ent[0]).cfg if ent else self.cfg
+        apply_fn = _jitted_apply(ty.name, cfg_k)
+        tvc = np.asarray(read_vc, np.int32).copy()
+        tvc[self.dc_id] += 1
+        tvc_j = jnp.asarray(tvc, jnp.int32)
+        origin = jnp.int32(self.dc_id)
+        ck = (key, bucket, tvc.tobytes())
+        cached = self._overlay_fold_cache.get(ck)
+        start = 0
+        if (cached is not None and cached[1] <= len(overlay)
+                and cached[2] == self._overlay_digest(overlay[: cached[1]])):
+            state, start = cached[0], cached[1]
+        else:
+            state = {f: jnp.asarray(x) for f, x in state.items()}
+        for w in overlay[start:]:
+            eff = eff_from_wire(w)
+            # the txn's blob payloads travel with its effects; the
+            # owner must intern them before value decode resolves
+            for h, data in eff.blob_refs:
+                store.blobs.intern_bytes(h, data)
+            state = apply_fn(
+                state,
+                jnp.asarray(_pad_lane(
+                    eff.eff_a, ty.eff_a_width(cfg_k), np.int64)),
+                jnp.asarray(_pad_lane(
+                    eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
+                tvc_j, origin,
+            )
+        self._overlay_fold_cache[ck] = (
+            state, len(overlay), self._overlay_digest(overlay))
+        while len(self._overlay_fold_cache) > 512:
+            self._overlay_fold_cache.popitem(last=False)
+        return jax.tree.map(np.asarray, state)
+
+    def _read_values_overlaid(self, objs, read_vc, overlays) -> list:
+        store = self.node.store
+        plain = [i for i, ov in enumerate(overlays) if not ov]
+        laid = [i for i, ov in enumerate(overlays) if ov]
+        vals: list = [None] * len(objs)
+        if plain:
+            pv = store.read_values([objs[i] for i in plain], read_vc)
+            for i, v in zip(plain, pv):
+                vals[i] = v
+        states = store.read_states([objs[i] for i in laid], read_vc)
+        for i, state in zip(laid, states):
+            key, type_name, bucket = objs[i]
+            ty = get_type(type_name)
+            state = self._overlay_state(key, type_name, bucket, state,
+                                        read_vc, overlays[i])
+            ent = store.locate(key, type_name, bucket, create=False)
+            cfg_k = store.table(ent[0]).cfg if ent else self.cfg
+            vals[i] = ty.value(state, store.blobs, cfg_k)
+        return vals
 
     def _wait_read_safe(self, shard: int, want_ts: int,
                         timeout: float = 30.0) -> None:
@@ -501,12 +617,18 @@ class ClusterMember:
                 )
             _t.sleep(0.001)
 
-    def m_downstream(self, key, type_name, bucket, op, read_vc) -> list:
+    def m_downstream(self, key, type_name, bucket, op, read_vc,
+                     overlay=None) -> list:
         """Generate downstream effects for a state-dependent op at my
         replica of the key (clocksi_downstream:generate_downstream_op,
-        /root/reference/src/clocksi_downstream.erl:38-68)."""
+        /root/reference/src/clocksi_downstream.erl:38-68).  counter_b
+        decrements/transfers run the escrow guard HERE at the key's
+        owner (bcounter_mgr parity): the rights check uses the owner's
+        replica state, and first-committer-wins certification closes
+        the check-to-commit race between concurrent coordinators."""
         from antidote_tpu.cluster.rpc import eff_to_wire
         from antidote_tpu.store.kv import Effect, scaled_cfg, split_tier
+        from antidote_tpu.txn.bcounter import NoPermissionsError
 
         key = freeze_key(key)
         op = _freeze_op(op)
@@ -523,6 +645,30 @@ class ClusterMember:
             state = store.read_states(
                 [(key, type_name, bucket)], read_vc
             )[0]
+            if overlay:
+                # the coordinator's txn already holds pending effects for
+                # this key: overlay them so the generated downstream
+                # observes them (same-txn add-then-remove)
+                state = self._overlay_state(key, type_name, bucket, state,
+                                            read_vc, overlay)
+            if type_name == "counter_b" and op[0] in ("decrement",
+                                                      "transfer"):
+                if op[0] == "decrement":
+                    amount, src_lane = op[1]
+                else:
+                    amount, _to_dc, src_lane = op[1]
+                if src_lane != self.dc_id:
+                    raise RuntimeError(
+                        f"abort: counter_b {op[0]} must spend this DC's "
+                        f"lane {self.dc_id}, not {src_lane}")
+                bcm = self.node.txm.bcounters
+                try:
+                    bcm.check_decrement(ty, state, key, bucket, amount)
+                except NoPermissionsError as e:
+                    if op[0] == "transfer":
+                        bcm.satisfied(key, bucket)
+                    raise RuntimeError(f"abort: {e}") from e
+                bcm.satisfied(key, bucket)
             ent = store.locate(key, type_name, bucket, create=False)
             cfg_k = store.table(ent[0]).cfg if ent else self.cfg
             effs = ty.downstream(op, state, store.blobs, cfg_k)
@@ -530,6 +676,33 @@ class ClusterMember:
             eff_to_wire(Effect(key, type_name, bucket, a, b, refs))
             for a, b, refs in effs
         ]
+
+    def m_process_transfer(self, key, bucket, amount: int, to_dc: int
+                           ) -> int:
+        """Grant up to ``amount`` bcounter rights to ``to_dc`` from this
+        DC's lane — the clustered bcounter_mgr:process_transfer: runs at
+        the key's owner member and commits the transfer through the DC
+        sequencer (this member's coordinator), so the grant is certified
+        like any other txn."""
+        from antidote_tpu.txn.manager import AbortError
+
+        key = freeze_key(key)
+        ty = get_type("counter_b")
+        state = self.node.store.read_states(
+            [(key, "counter_b", bucket)], self.node.store.dc_max_vc()
+        )[0]
+        held = ty.local_rights(state, self.dc_id)
+        grant = min(int(amount), held)
+        if grant <= 0:
+            return 0
+        try:
+            self.coordinator().update_objects([
+                (key, "counter_b", bucket,
+                 ("transfer", (grant, int(to_dc), self.dc_id))),
+            ])
+        except AbortError:
+            return 0  # lost a race for the rights; requester retries
+        return grant
 
     def m_prepare(self, txid: int, effs_wire: list, snap_own: int) -> bool:
         """Certify + lock this txn's keys on my shards
@@ -563,10 +736,11 @@ class ClusterMember:
                 dk = (eff.key, eff.bucket)
                 self.prepared[dk] = txid
                 keys.append(dk)
-            self.staged[txid] = (effects, keys)
+            self.staged[txid] = (effects, keys, int(snap_own))
             self.staged_at[txid] = time.monotonic()
             self._prep_append({"ev": "prep", "txid": int(txid),
-                               "effs": effs_wire})
+                               "effs": effs_wire,
+                               "snap": int(snap_own)})
         return True
 
     def m_abort(self, txid: int) -> bool:
@@ -593,11 +767,21 @@ class ClusterMember:
             if not resolved and txid in self.blocked_txns:
                 raise RuntimeError(
                     f"abort: txn {txid} is blocked pending takeover")
-            effects, keys = self.staged.pop(txid, (None, None))
+            effects, keys, snap_own = self.staged.pop(
+                txid, (None, None, 0))
             if effects is None:
                 return True  # duplicate commit
             self.staged_at.pop(txid, None)
             self.blocked_txns.discard(txid)
+            # rewrite tentative own dots (overlay stamp = snapshot+1) to
+            # the real commit ts (restamp_own_dots; see txn/manager.py);
+            # snap_own None = legacy prep record, no tentative dots
+            if snap_own is not None and snap_own + 1 != ts:
+                for eff in effects:
+                    ty_e = get_type(eff.type_name)
+                    eff.eff_a, eff.eff_b = ty_e.restamp_own_dots(
+                        self.cfg, eff.eff_a, eff.eff_b, self.dc_id,
+                        snap_own + 1, ts)
             self._prep_append({
                 "ev": "commit", "txid": int(txid),
                 "vc": [int(x) for x in commit_vc],

@@ -91,6 +91,25 @@ class CRDTType(abc.ABC):
     ) -> Any:
         """Client-visible value of a host state copy (Type:value/1)."""
 
+    def stamp_op_seq(self, eff_a, eff_b, seq: int):
+        """Number an effect within its transaction (per key).  Types
+        whose apply derives identity from the commit clock alone (rga
+        uids) carry the sequence in an effect lane so same-commit ops
+        stay distinguishable.  Default: identity."""
+        return eff_a, eff_b
+
+    def restamp_own_dots(self, cfg: AntidoteConfig, eff_a, eff_b,
+                         my_dc: int, tentative_own: int, commit_own: int):
+        """Rewrite dots an effect observed from the txn's OWN uncommitted
+        writes: overlay applies stamp pending effects with a tentative
+        own-lane ts (snapshot+1); the real commit ts may differ when
+        other txns committed in between, so observed-VC lanes / packed
+        ids equal to the tentative value are rewritten to the commit ts
+        at commit time.  No collision with real observations is possible:
+        anything observed from the snapshot has own-lane ts ≤ snapshot <
+        tentative.  Default: the effect observes no dots — unchanged."""
+        return eff_a, eff_b
+
     # ---- device side ---------------------------------------------------
 
     @abc.abstractmethod

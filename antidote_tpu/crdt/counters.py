@@ -120,6 +120,14 @@ class CounterFat(CRDTType):
         b[1 : 1 + d] = np.asarray(state["epoch"], dtype=np.int32)
         return [(a, b, [])]
 
+    def restamp_own_dots(self, cfg, eff_a, eff_b, my_dc, tentative_own,
+                         commit_own):
+        # reset effects observe the per-lane epoch VC at eff_b[1:1+d]
+        if int(eff_b[0]) == 1 and int(eff_b[1 + my_dc]) == tentative_own:
+            eff_b = np.array(eff_b, copy=True)
+            eff_b[1 + my_dc] = commit_own
+        return eff_a, eff_b
+
     def value(self, state, blobs, cfg):
         return int(np.sum(np.asarray(state["amt"])))
 

@@ -118,6 +118,16 @@ class RegisterMV(TopCountResolved, CRDTType):
         a[1 : 1 + obs.shape[0]] = obs
         return [(a, pack_b([], width=self.eff_b_width(cfg)), [(h, blobs.bytes_of(h))])]
 
+    def restamp_own_dots(self, cfg, eff_a, eff_b, my_dc, tentative_own,
+                         commit_own):
+        # eff_a[1:1+mv_slots] are observed entry ids packed (ts<<8)|dc
+        tent_id = (int(tentative_own) << 8) | my_dc
+        obs = np.asarray(eff_a[1:], dtype=np.int64)
+        if (obs == tent_id).any():
+            eff_a = np.array(eff_a, copy=True)
+            eff_a[1:][obs == tent_id] = (int(commit_own) << 8) | my_dc
+        return eff_a, eff_b
+
     def value(self, state, blobs, cfg):
         from antidote_tpu.crdt.base import warn_overflow_state
 

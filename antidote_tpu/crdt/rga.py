@@ -7,7 +7,10 @@ origin, skipping over any sibling elements whose insertion dot is larger.
 
 Dense layout per key (S = cfg.rga_slots), kept in list order:
 
-  uid   i64[S]  insertion dot = (commit counter at origin << 8) | origin
+  uid   i64[S]  insertion dot = (commit ts at origin << 24) |
+                (op seq within txn << 8) | origin — the op-seq lane
+                keeps uids unique when one txn inserts several
+                elements (they share a commit ts)
   elem  i64[S]  value handle (0 = empty slot)
   tomb  i32[S]  1 = deleted (tombstones keep order; GC'able once stable)
   ovf   i32     inserts dropped for lack of slots
@@ -41,6 +44,16 @@ class RGA(CRDTType):
 
     def eff_a_width(self, cfg):
         return 2  # [elem_handle | target_uid, origin_uid]
+
+    def eff_b_width(self, cfg):
+        return 2  # [kind, op-seq within txn]
+
+    def stamp_op_seq(self, eff_a, eff_b, seq: int):
+        # the txn layer numbers a key's effects within the txn; the lane
+        # disambiguates uids of same-commit inserts
+        eff_b = np.array(eff_b, copy=True)
+        eff_b[1] = seq
+        return eff_a, eff_b
 
     def state_spec(self, cfg):
         s = cfg.rga_slots
@@ -94,6 +107,26 @@ class RGA(CRDTType):
         a[1] = origin_uid
         return [(a, b, [(h, blobs.bytes_of(h))])]
 
+    def restamp_own_dots(self, cfg, eff_a, eff_b, my_dc, tentative_own,
+                         commit_own):
+        # eff_a[0] (delete target) / eff_a[1] (insert origin) are uids
+        # packed (ts<<8)|dc — rewrite references to the txn's own
+        # tentative-stamped elements
+        def is_tent(u):
+            return (u >> 24) == int(tentative_own) and (u & 0xFF) == my_dc
+
+        def re(u):
+            return ((int(commit_own) << 24) | (u & 0xFFFFFF))
+
+        a0, a1 = int(eff_a[0]), int(eff_a[1])
+        if is_tent(a0) or is_tent(a1):
+            eff_a = np.array(eff_a, copy=True)
+            if is_tent(a0):
+                eff_a[0] = re(a0)
+            if is_tent(a1):
+                eff_a[1] = re(a1)
+        return eff_a, eff_b
+
     def slot_capacity(self, cfg):
         return cfg.rga_slots
 
@@ -126,8 +159,10 @@ class RGA(CRDTType):
         h = eff_a[0]
         origin_uid = eff_a[1]
         new_uid = (
-            commit_vc[origin_dc].astype(jnp.int64) << 8
-        ) | origin_dc.astype(jnp.int64)
+            (commit_vc[origin_dc].astype(jnp.int64) << 24)
+            | (eff_b[1].astype(jnp.int64) << 8)
+            | origin_dc.astype(jnp.int64)
+        )
         occupied = uid != 0
         o_hit = uid == origin_uid
         # position of origin (-1 = head); if the origin was never inserted

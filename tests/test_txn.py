@@ -257,3 +257,51 @@ def test_value_cache_nested_map_mutation_isolated(node):
     v[0][("n", "map_rr")][("c", "counter_pn")] = 999
     v2, _ = node.read_objects([("mm", "map_rr", "b")])
     assert v2[0][("n", "map_rr")][("c", "counter_pn")] == 1
+
+
+def test_overlay_dots_restamped_under_interleaved_commits(node):
+    """A txn's remove observing its OWN in-txn add must survive other
+    txns committing in between (the tentative own-lane dot is rewritten
+    to the real commit ts at commit — restamp_own_dots)."""
+    txn = node.start_transaction()
+    node.update_objects([("s", "set_aw", "b", ("add", "x"))], txn)
+    # interleaved commits advance the commit counter past the tentative
+    for i in range(3):
+        node.update_objects([(f"o{i}", "counter_pn", "b", ("increment", 1))])
+    node.update_objects([("s", "set_aw", "b", ("remove", "x"))], txn)
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("s", "set_aw", "b")])
+    assert vals[0] == [], "same-txn remove lost under interleaving"
+    # mv register: second assign observes the first's tentative id
+    txn = node.start_transaction()
+    node.update_objects([("r", "register_mv", "b", ("assign", "a"))], txn)
+    node.update_objects([("x", "counter_pn", "b", ("increment", 1))])
+    node.update_objects([("r", "register_mv", "b", ("assign", "b"))], txn)
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("r", "register_mv", "b")])
+    assert vals[0] == ["b"], "observed-overwrite lost under interleaving"
+
+
+def test_rga_same_txn_inserts_have_distinct_uids(node):
+    """One txn inserting several elements: each element's uid must stay
+    unique (op-seq lane), so a later delete targets the RIGHT one."""
+    txn = node.start_transaction()
+    node.update_objects([("d", "rga", "b", ("insert", (0, "a")))], txn)
+    node.update_objects([("d", "rga", "b", ("insert", (1, "b")))], txn)
+    node.update_objects([("d", "rga", "b", ("insert", (2, "c")))], txn)
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("d", "rga", "b")])
+    assert vals[0] == ["a", "b", "c"]
+    node.update_objects([("d", "rga", "b", ("delete", 1))])
+    vals, _ = node.read_objects([("d", "rga", "b")])
+    assert vals[0] == ["a", "c"], "delete hit the wrong same-commit uid"
+    # interleaved-commit variant: delete an element inserted in an open
+    # txn whose tentative ts got stale
+    txn = node.start_transaction()
+    node.update_objects([("d2", "rga", "b", ("insert", (0, "p")))], txn)
+    node.update_objects([("z", "counter_pn", "b", ("increment", 1))])
+    node.update_objects([("d2", "rga", "b", ("insert", (1, "q")))], txn)
+    node.update_objects([("d2", "rga", "b", ("delete", 0))], txn)
+    node.commit_transaction(txn)
+    vals, _ = node.read_objects([("d2", "rga", "b")])
+    assert vals[0] == ["q"]
