@@ -49,6 +49,9 @@ class ClusterTxn:
         self.snapshot_vc = np.asarray(snapshot_vc, np.int32)
         self.writeset: List[Effect] = []
         self.active = True
+        #: (key, bucket) -> (effects shipped to the owner, digest) for
+        #: incremental overlay shipping (only NEW effects go over RPC)
+        self.overlay_sent: Dict[tuple, tuple] = {}
 
 
 class ClusterNode:
@@ -131,8 +134,11 @@ class ClusterNode:
     def read_objects(self, objects: Sequence, txn=None, clock=None):
         if txn is None:
             t = self.start_transaction(clock)
-            vals = self._read(objects, t)
-            t.active = False
+            try:
+                vals = self._read(objects, t)
+            finally:
+                t.active = False
+                self._txns.pop(t.txid, None)  # autocommit: unregister
             return vals, t.snapshot_vc
         return self._read(objects, txn)
 
@@ -149,39 +155,72 @@ class ClusterNode:
         # to the owners, who overlay them on the snapshot state
         # (materialize_eager at the owner; clocksi_interactive_coord
         # apply_tx_updates_to_snapshot,
-        # /root/reference/src/clocksi_interactive_coord.erl:882-894)
-        pend_by_dk: Dict[tuple, list] = {}
-        if txn.writeset:
-            for eff in txn.writeset:
-                pend_by_dk.setdefault((eff.key, eff.bucket), []).append(
-                    eff_to_wire(eff))
+        # /root/reference/src/clocksi_interactive_coord.erl:882-894).
+        # Incremental: only effects the owner hasn't folded yet travel.
         for owner, items in by_owner.items():
             objs = [o for _, o in items]
-            overlays = [pend_by_dk.get((k, b)) for (k, _t, b) in objs] \
-                if pend_by_dk else None
-            if owner is None:
-                vals = [
-                    unwire_value(v) for v in self.member.m_read_values(
-                        objs, txn.snapshot_vc, overlays
-                    )
-                ]
-            else:
-                vals = [
-                    unwire_value(v)
-                    for v in self.member.peers[owner].call(
-                        "m_read_values", objs,
-                        [int(x) for x in txn.snapshot_vc], overlays,
-                    )
-                ]
+            for full in (False, True):
+                overlays = None
+                if txn.writeset:
+                    overlays = [
+                        self._overlay_payload(txn, k, b, full=full)
+                        for (k, _t, b) in objs
+                    ]
+                    if not any(overlays):
+                        overlays = None
+                try:
+                    if owner is None:
+                        wvals = self.member.m_read_values(
+                            objs, txn.snapshot_vc, overlays)
+                    else:
+                        wvals = self.member.peers[owner].call(
+                            "m_read_values", objs,
+                            [int(x) for x in txn.snapshot_vc], overlays)
+                except RuntimeError as e:
+                    if not full and "overlay-resync" in str(e):
+                        continue  # owner lost the prefix: resend in full
+                    raise
+                if overlays:
+                    self._overlay_mark_sent(txn, objs, overlays)
+                break
+            vals = [unwire_value(v) for v in wvals]
             for (i, _), v in zip(items, vals):
                 out[i] = v
         return out
+
+    # -- incremental overlay shipping ----------------------------------
+    def _overlay_payload(self, txn: ClusterTxn, key, bucket,
+                         full: bool = False):
+        from antidote_tpu.cluster.member import overlay_digest
+
+        pend = [e for e in txn.writeset
+                if e.key == key and e.bucket == bucket]
+        if not pend:
+            return None
+        dk = (key, bucket)
+        n0, d0 = (0, 0) if full else txn.overlay_sent.get(dk, (0, 0))
+        wires = [eff_to_wire(e) for e in pend[n0:]]
+        nd = overlay_digest(d0, wires)
+        return {"n": n0, "d": d0, "effs": wires, "nd": nd,
+                "_total": len(pend)}
+
+    @staticmethod
+    def _overlay_mark_sent(txn: ClusterTxn, objs, overlays) -> None:
+        for (k, _t, b), ov in zip(objs, overlays):
+            if ov is not None:
+                txn.overlay_sent[(k, b)] = (ov["_total"], ov["nd"])
 
     # ------------------------------------------------------------------
     def update_objects(self, updates: Sequence, txn=None, clock=None):
         if txn is None:
             t = self.start_transaction(clock)
-            self._update(updates, t)
+            try:
+                self._update(updates, t)
+            except BaseException:
+                # a failed autocommit txn must not linger in the registry
+                if t.active:
+                    self.abort_transaction(t)
+                raise
             return self.commit_transaction(t)
         self._update(updates, txn)
 
@@ -214,26 +253,36 @@ class ClusterNode:
             if ty.require_state_downstream(op) or guarded_b:
                 # the owner generates against its replica's state, with
                 # the txn's own pending effects for the key overlaid
-                # (observed-remove must see same-txn adds)
+                # (observed-remove must see same-txn adds); incremental
+                # shipping with a full-resend fallback on overlay-resync
                 owner = self._owner_of(key, bucket)
-                overlay = [eff_to_wire(e) for e in txn.writeset
-                           if e.key == key and e.bucket == bucket] or None
-                try:
-                    if owner is None:
-                        wires = self.member.m_downstream(
-                            key, type_name, bucket, op, txn.snapshot_vc,
-                            overlay,
-                        )
-                    else:
-                        wires = self.member.peers[owner].call(
-                            "m_downstream", key, type_name, bucket, op,
-                            [int(x) for x in txn.snapshot_vc], overlay,
-                        )
-                except RuntimeError as e:
-                    if "abort" in str(e):
-                        self.abort_transaction(txn)
-                        raise AbortError(str(e)) from e
-                    raise
+                for full in (False, True):
+                    overlay = self._overlay_payload(txn, key, bucket,
+                                                    full=full)
+                    try:
+                        if owner is None:
+                            wires = self.member.m_downstream(
+                                key, type_name, bucket, op,
+                                txn.snapshot_vc, overlay,
+                            )
+                        else:
+                            wires = self.member.peers[owner].call(
+                                "m_downstream", key, type_name, bucket,
+                                op, [int(x) for x in txn.snapshot_vc],
+                                overlay,
+                            )
+                    except RuntimeError as e:
+                        if (not full and overlay is not None
+                                and "overlay-resync" in str(e)):
+                            continue
+                        if "abort" in str(e):
+                            self.abort_transaction(txn)
+                            raise AbortError(str(e)) from e
+                        raise
+                    if overlay is not None:
+                        self._overlay_mark_sent(
+                            txn, [(key, type_name, bucket)], [overlay])
+                    break
                 from antidote_tpu.cluster.rpc import eff_from_wire
 
                 seq = self._pend_count(txn, key, bucket)

@@ -76,6 +76,17 @@ def owned_shards(cfg: AntidoteConfig, member_id: int, n_members: int):
 _LEDGER_CAP = 8192
 
 
+def overlay_digest(seed: int, wires) -> int:
+    """Rolling, process-independent fingerprint of an effect-wire
+    sequence (incremental overlay shipping)."""
+    import zlib
+
+    d = seed
+    for w in wires:
+        d = zlib.crc32(w["eb"], zlib.crc32(w["a"], d)) & 0xFFFFFFFF
+    return d
+
+
 class Sequencer:
     """DC-wide commit-timestamp authority (member 0).
 
@@ -522,10 +533,6 @@ class ClusterMember:
                 vals = self._read_values_overlaid(objs, read_vc, overlays)
         return [_wire_value(v) for v in vals]
 
-    @staticmethod
-    def _overlay_digest(wires) -> int:
-        return hash(tuple((w["a"], w["eb"]) for w in wires))
-
     def _overlay_state(self, key, type_name, bucket, state, read_vc,
                        overlay) -> dict:
         """Fold a txn's pending effect wires onto a host state copy
@@ -533,11 +540,15 @@ class ClusterMember:
         is read_vc[own]+1 = snapshot+1 — the same value m_commit's
         restamp rewrites to the real commit ts.
 
-        Folds are cached per (key, bucket, read VC) with a prefix
-        fingerprint: a coordinator re-sending its txn's growing effect
-        list only pays for the NEW effects (O(N) total, not O(N^2)); a
-        different txn's overlay on the same key misses the fingerprint
-        and rebuilds."""
+        ``overlay`` is either a full wire list (legacy) or the
+        incremental form ``{"n": prefix_len, "d": prefix_digest,
+        "effs": [new wires], "nd": digest after}`` — the coordinator
+        ships only the effects the owner has not folded yet (O(N) wire
+        bytes AND folds over a txn's life, not O(N^2)).  An owner that
+        lost its cached prefix (restart, eviction) raises
+        ``overlay-resync`` and the coordinator re-sends in full.  The
+        digest is a process-independent rolling CRC (python ``hash`` is
+        per-process-seeded)."""
         import jax
         import jax.numpy as jnp
 
@@ -555,13 +566,30 @@ class ClusterMember:
         origin = jnp.int32(self.dc_id)
         ck = (key, bucket, tvc.tobytes())
         cached = self._overlay_fold_cache.get(ck)
-        start = 0
-        if (cached is not None and cached[1] <= len(overlay)
-                and cached[2] == self._overlay_digest(overlay[: cached[1]])):
-            state, start = cached[0], cached[1]
-        else:
+        if isinstance(overlay, dict):
+            n0, d0 = int(overlay["n"]), int(overlay["d"])
+            wires, nd = overlay["effs"], int(overlay["nd"])
+            if n0 == 0:
+                state = {f: jnp.asarray(x) for f, x in state.items()}
+            elif (cached is not None and cached[1] == n0
+                    and cached[2] == d0):
+                state = cached[0]
+            else:
+                raise RuntimeError(
+                    "overlay-resync: owner has no matching overlay "
+                    f"prefix for {key!r} (have "
+                    f"{None if cached is None else cached[1:3]}, "
+                    f"want ({n0}, {d0}))")
+            n_total = n0 + len(wires)
+        else:  # legacy full list
+            wires = overlay
+            nd = overlay_digest(0, wires)
+            n_total = len(wires)
+            if (cached is not None and cached[1] == n_total
+                    and cached[2] == nd):
+                return jax.tree.map(np.asarray, cached[0])
             state = {f: jnp.asarray(x) for f, x in state.items()}
-        for w in overlay[start:]:
+        for w in wires:
             eff = eff_from_wire(w)
             # the txn's blob payloads travel with its effects; the
             # owner must intern them before value decode resolves
@@ -575,8 +603,7 @@ class ClusterMember:
                     eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
                 tvc_j, origin,
             )
-        self._overlay_fold_cache[ck] = (
-            state, len(overlay), self._overlay_digest(overlay))
+        self._overlay_fold_cache[ck] = (state, n_total, nd)
         while len(self._overlay_fold_cache) > 512:
             self._overlay_fold_cache.popitem(last=False)
         return jax.tree.map(np.asarray, state)

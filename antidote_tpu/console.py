@@ -79,9 +79,32 @@ def cmd_serve(args) -> int:
     if not all(probes.values()):
         log(f"NOT READY: {probes}")
         return 1
-    server = ProtocolServer(node, host=args.host, port=args.port)
+    # the OTP supervision tree (antidote_sup one_for_one, 5-in-10s,
+    # /root/reference/src/antidote_sup.erl:137): listener + metrics run
+    # as supervised children; a flapping child takes the node down
+    from antidote_tpu.supervise import Supervisor
+
+    sup = Supervisor(on_giveup=lambda name: os._exit(70))
+    server_box = {}
+
+    def start_proto():
+        port = server_box["srv"].port if "srv" in server_box else args.port
+        server_box["srv"] = ProtocolServer(node, host=args.host, port=port)
+        return server_box["srv"]
+
+    sup.add("proto", start_proto, alive=lambda s: s.is_alive(),
+            stop=lambda s: s.close())
     if args.metrics_port is not None:
-        node.serve_metrics(args.metrics_port)
+        def stop_metrics(m):
+            m.close()
+            node._metrics_server = None  # a restart builds a fresh one
+
+        sup.add("metrics",
+                lambda: node.serve_metrics(args.metrics_port),
+                alive=lambda m: m._thread.is_alive(),
+                stop=stop_metrics)
+    sup.start()
+    server = server_box["srv"]
     log(f"antidote_tpu dc{args.dc_id} serving on "
         f"{server.host}:{server.port} (recovered={recover}, "
         f"keys={len(node.store.directory)})")
@@ -92,7 +115,7 @@ def cmd_serve(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         log("shutting down")
-        server.close()
+        sup.shutdown()
     return 0
 
 

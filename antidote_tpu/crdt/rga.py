@@ -118,12 +118,18 @@ class RGA(CRDTType):
         def re(u):
             return ((int(commit_own) << 24) | (u & 0xFFFFFF))
 
+        # uid lanes by kind: deletes target a uid in a0 (a0 of an INSERT
+        # is a blob handle — never rewrite it); inserts reference their
+        # origin uid in a1
+        is_delete = int(eff_b[0]) == _DELETE
         a0, a1 = int(eff_a[0]), int(eff_a[1])
-        if is_tent(a0) or is_tent(a1):
+        fix0 = is_delete and is_tent(a0)
+        fix1 = (not is_delete) and is_tent(a1)
+        if fix0 or fix1:
             eff_a = np.array(eff_a, copy=True)
-            if is_tent(a0):
+            if fix0:
                 eff_a[0] = re(a0)
-            if is_tent(a1):
+            if fix1:
                 eff_a[1] = re(a1)
         return eff_a, eff_b
 

@@ -257,6 +257,10 @@ class ProtocolServer:
         return txn
 
     # ------------------------------------------------------------------
+    def is_alive(self) -> bool:
+        """Supervision probe (supervise.Supervisor child health)."""
+        return self._thread.is_alive()
+
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()

@@ -610,5 +610,9 @@ class TransactionManager:
                     origin,
                 )
             txn.overlay_cache[dk] = (state, len(pend))
-            states[i] = jax.tree.map(np.asarray, state)
+            # hand back the device-resident overlaid state: consumers
+            # (downstream generators, value decoders) np.asarray only the
+            # fields they touch — converting all of them eagerly was the
+            # rga populate hot spot
+            states[i] = state
         return states

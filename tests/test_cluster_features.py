@@ -208,3 +208,27 @@ def test_cluster_bcounter_transfer_from_clustered_dc():
         m0.refresh_peer_clocks(), m1.refresh_peer_clocks()
     assert vals_c == [7]
     m0.close(), m1.close()
+
+
+def test_overlay_resync_after_owner_cache_loss():
+    """Incremental overlay shipping: when the owner loses its folded
+    prefix (restart/eviction), the coordinator's next call triggers
+    overlay-resync and transparently re-sends in full."""
+    cfg = _cfg()
+    m0, m1 = _duo(cfg)
+    c1 = ClusterNode(m1)
+    k = _key_on(cfg, m0, "rs")
+    txn = c1.start_transaction()
+    c1.update_objects([(k, "set_aw", "b", ("add", "a"))], txn)
+    assert c1.read_objects([(k, "set_aw", "b")], txn) == [["a"]]
+    c1.update_objects([(k, "set_aw", "b", ("add", "b"))], txn)
+    # the owner "restarts": folded overlay prefixes are gone
+    m0._overlay_fold_cache.clear()
+    assert c1.read_objects([(k, "set_aw", "b")], txn) == [["a", "b"]]
+    # and the incremental path resumes afterwards
+    c1.update_objects([(k, "set_aw", "b", ("remove", "a"))], txn)
+    assert c1.read_objects([(k, "set_aw", "b")], txn) == [["b"]]
+    c1.commit_transaction(txn)
+    vals, _ = c1.read_objects([(k, "set_aw", "b")])
+    assert vals == [["b"]]
+    m0.close(), m1.close()
