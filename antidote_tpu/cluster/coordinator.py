@@ -52,6 +52,13 @@ class ClusterTxn:
         #: (key, bucket) -> (effects shipped to the owner, digest) for
         #: incremental overlay shipping (only NEW effects go over RPC)
         self.overlay_sent: Dict[tuple, tuple] = {}
+        #: (key, bucket) -> [Effect] — per-key view of the writeset so
+        #: per-op overlay building is O(pending-for-key), not O(writeset)
+        self.pend_idx: Dict[tuple, list] = {}
+
+    def add_effect(self, eff: Effect) -> None:
+        self.writeset.append(eff)
+        self.pend_idx.setdefault((eff.key, eff.bucket), []).append(eff)
 
 
 class ClusterNode:
@@ -193,8 +200,7 @@ class ClusterNode:
                          full: bool = False):
         from antidote_tpu.cluster.member import overlay_digest
 
-        pend = [e for e in txn.writeset
-                if e.key == key and e.bucket == bucket]
+        pend = txn.pend_idx.get((key, bucket))
         if not pend:
             return None
         dk = (key, bucket)
@@ -291,21 +297,18 @@ class ClusterNode:
                     eff.eff_a, eff.eff_b = ty.stamp_op_seq(
                         eff.eff_a, eff.eff_b, seq)
                     seq += 1
-                    txn.writeset.append(eff)
+                    txn.add_effect(eff)
             else:
                 blobs = self.member.node.store.blobs
                 seq = self._pend_count(txn, key, bucket)
                 for a, b, refs in ty.downstream(op, None, blobs, self.cfg):
                     a, b = ty.stamp_op_seq(a, b, seq)
                     seq += 1
-                    txn.writeset.append(
-                        Effect(key, type_name, bucket, a, b, refs)
-                    )
+                    txn.add_effect(Effect(key, type_name, bucket, a, b, refs))
 
     @staticmethod
     def _pend_count(txn: ClusterTxn, key, bucket) -> int:
-        return sum(1 for e in txn.writeset
-                   if e.key == key and e.bucket == bucket)
+        return len(txn.pend_idx.get((key, bucket), ()))
 
     # ------------------------------------------------------------------
     def commit_transaction(self, txn: ClusterTxn) -> np.ndarray:
@@ -384,6 +387,7 @@ class ClusterNode:
     def abort_transaction(self, txn: ClusterTxn) -> None:
         txn.active = False
         txn.writeset.clear()
+        txn.pend_idx.clear()
         self._txns.pop(txn.txid, None)
 
     # ------------------------------------------------------------------

@@ -566,29 +566,25 @@ class ClusterMember:
         origin = jnp.int32(self.dc_id)
         ck = (key, bucket, tvc.tobytes())
         cached = self._overlay_fold_cache.get(ck)
-        if isinstance(overlay, dict):
-            n0, d0 = int(overlay["n"]), int(overlay["d"])
-            wires, nd = overlay["effs"], int(overlay["nd"])
-            if n0 == 0:
-                state = {f: jnp.asarray(x) for f, x in state.items()}
-            elif (cached is not None and cached[1] == n0
-                    and cached[2] == d0):
-                state = cached[0]
-            else:
-                raise RuntimeError(
-                    "overlay-resync: owner has no matching overlay "
-                    f"prefix for {key!r} (have "
-                    f"{None if cached is None else cached[1:3]}, "
-                    f"want ({n0}, {d0}))")
-            n_total = n0 + len(wires)
-        else:  # legacy full list
-            wires = overlay
-            nd = overlay_digest(0, wires)
-            n_total = len(wires)
-            if (cached is not None and cached[1] == n_total
-                    and cached[2] == nd):
-                return jax.tree.map(np.asarray, cached[0])
+        n0, d0 = int(overlay["n"]), int(overlay["d"])
+        wires, nd = overlay["effs"], int(overlay["nd"])
+        n_total = n0 + len(wires)
+        if (cached is not None and cached[1] == n_total
+                and cached[2] == nd):
+            # idempotent re-send (e.g. the same object twice in one read
+            # batch): the suffix is already folded
+            return jax.tree.map(np.asarray, cached[0])
+        if n0 == 0:
             state = {f: jnp.asarray(x) for f, x in state.items()}
+        elif (cached is not None and cached[1] == n0
+                and cached[2] == d0):
+            state = cached[0]
+        else:
+            raise RuntimeError(
+                "overlay-resync: owner has no matching overlay "
+                f"prefix for {key!r} (have "
+                f"{None if cached is None else cached[1:3]}, "
+                f"want ({n0}, {d0}))")
         for w in wires:
             eff = eff_from_wire(w)
             # the txn's blob payloads travel with its effects; the

@@ -96,8 +96,11 @@ def cmd_serve(args) -> int:
             stop=lambda s: s.close())
     if args.metrics_port is not None:
         def stop_metrics(m):
+            # clear the cached handle FIRST: a close() failure must not
+            # leave serve_metrics returning the dead server forever (the
+            # flap would reach restart intensity and kill the node)
+            node._metrics_server = None
             m.close()
-            node._metrics_server = None  # a restart builds a fresh one
 
         sup.add("metrics",
                 lambda: node.serve_metrics(args.metrics_port),

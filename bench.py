@@ -495,6 +495,51 @@ def child(args):
     log("spot-check: serving values match host materializer "
         "(fresh + historical) on 64 keys")
 
+    # ---- mixed load: appends (with ring-GC folds) interleave the serve
+    # pipeline — the r3 VERDICT asked for append/GC measured UNDER load,
+    # not only correctness-tested (run LAST: the writes advance the table
+    # past the clocks the earlier phases and the spot check read at)
+    write_batch = max(256, serve_batch // 16)
+    mixed_batches = max(8, serve_batches // 2)
+    writes = 0
+
+    def mixed_append(i):
+        nonlocal writes
+        kk = streams[(i * 7 + 3) % n_streams][:write_batch]
+        ss, rr = srows(kk)
+        vcs = np.zeros((write_batch, d), np.int32)
+        vcs[:, 0] = final_t + writes + 1 + np.arange(write_batch)
+        table.append(ss, rr,
+                     rng.integers(1, 1 << 62, size=(write_batch, 1),
+                                  dtype=np.int64),
+                     np.zeros((write_batch, bw), np.int32), vcs,
+                     np.zeros(write_batch, np.int32))
+        writes += write_batch
+
+    with phase("warmup_mixed"):
+        # compile the append/GC/stale-serve shapes outside the timer
+        mixed_append(-1)
+        r0, _, _ = serve_one(0)
+        np.asarray(r0["top"])
+    with phase("mixed_load"):
+        mq = collections.deque()
+        t0 = time.perf_counter()
+        for i in range(mixed_batches):
+            mixed_append(i)
+            resolved, fresh, complete = serve_one(i)  # reads at old final
+            for x in resolved.values():
+                x.copy_to_host_async()
+            mq.append(resolved)
+            if len(mq) > 8:
+                np.asarray(mq.popleft()["top"])
+        while mq:
+            np.asarray(mq.popleft()["top"])
+        mixed_elapsed = time.perf_counter() - t0
+    mixed_read_rps = mixed_batches * serve_batch / mixed_elapsed
+    mixed_write_rps = (writes - write_batch) / mixed_elapsed  # minus warmup
+    log(f"mixed load: {mixed_read_rps:,.0f} reads/s + "
+        f"{mixed_write_rps:,.0f} appends/s sustained")
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(serving_rps, 1),
@@ -510,6 +555,8 @@ def child(args):
         "stale_fraction_historical": round(float(np.mean(stale_hist)), 3),
         "serve_batch_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
         "serve_batch_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "mixed_read_rps": round(mixed_read_rps, 1),
+        "mixed_write_rps": round(mixed_write_rps, 1),
         "device_rtt_p50_ms": round(float(np.percentile(rtt_ms, 50)), 2),
         "use_pallas": bool(cfg.use_pallas),
         "platform": platform,

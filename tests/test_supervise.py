@@ -77,10 +77,50 @@ def test_restart_intensity_gives_up():
     assert healthy.handle is None
 
 
-def test_release_serve_restarts_protocol_listener(tmp_path):
-    """End to end: kill the protocol server's accept thread inside a
-    real `console serve` process; the supervisor restarts it on the
-    same port and clients keep working."""
+def test_supervised_protocol_listener_restarts_on_same_port():
+    """The console-serve wiring, in process: kill the protocol server
+    (its accept thread exits); the supervisor rebuilds it via the
+    start factory ON THE SAME PORT and clients keep working."""
+    from antidote_tpu.api import AntidoteNode
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.server import ProtocolServer
+
+    node = AntidoteNode(AntidoteConfig(
+        n_shards=4, max_dcs=2, keys_per_table=256, batch_buckets=(16, 64)))
+    box = {}
+
+    def start_proto():
+        port = box["srv"].port if "srv" in box else 0
+        box["srv"] = ProtocolServer(node, port=port)
+        return box["srv"]
+
+    sup = Supervisor(poll_s=0.05)
+    sup.add("proto", start_proto, alive=lambda s: s.is_alive(),
+            stop=lambda s: s.close())
+    sup.start()
+    first = box["srv"]
+    port = first.port
+    c = AntidoteClient("127.0.0.1", port)
+    c.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    c.close()
+    first._server.shutdown()  # the listener "crashes"
+    for _ in range(100):
+        if box["srv"] is not first and box["srv"].is_alive():
+            break
+        time.sleep(0.05)
+    assert box["srv"] is not first, "supervisor never restarted the child"
+    assert box["srv"].port == port, "restart must rebind the same port"
+    c2 = AntidoteClient("127.0.0.1", port)
+    vals, _ = c2.read_objects([("k", "counter_pn", "b")])
+    assert vals == [1]
+    c2.close()
+    sup.shutdown()
+
+
+def test_release_serve_survives_hostile_frames(tmp_path):
+    """End to end resilience probe against a real `console serve`
+    process: an oversized frame must not take the listener down."""
     import json
     import os
     import subprocess
