@@ -152,6 +152,40 @@ class ClusterNode:
     def _read(self, objects, txn: ClusterTxn) -> list:
         assert txn.active
         out: List[Any] = [None] * len(objects)
+        # composite (map) objects assemble recursively: ONE membership
+        # read for the batch, then one field read per nesting level, all
+        # routed through this method (the cluster rendering of
+        # TransactionManager._assemble_maps)
+        comp = [i for i, (_k, t, _b) in enumerate(objects)
+                if is_type(t) and getattr(get_type(t), "composite", False)]
+        if comp:
+            from antidote_tpu.crdt import maps as maps_mod
+
+            comp_objs = [objects[i] for i in comp]
+            membs = self._read(
+                [(maps_mod.member_key(freeze_key(k)),
+                  maps_mod.MAP_MEMBERSHIP[t], b)
+                 for k, t, b in comp_objs], txn)
+            field_objs, spans = [], []
+            for (key, t, bucket), memb in zip(comp_objs, membs):
+                fields = [tuple(x) for x in memb]
+                spans.append((len(field_objs), fields))
+                field_objs.extend(
+                    (maps_mod.field_key(freeze_key(key), f, ft), ft, bucket)
+                    for f, ft in fields
+                )
+            nested = self._read(field_objs, txn) if field_objs else []
+            for i, (base, fields) in zip(comp, spans):
+                out[i] = {(f, ft): nested[base + j]
+                          for j, (f, ft) in enumerate(fields)}
+            comp_set = set(comp)
+            objects = [o for i, o in enumerate(objects)
+                       if i not in comp_set]
+            if not objects:
+                return out
+            remap = [i for i in range(len(out)) if i not in comp_set]
+        else:
+            remap = list(range(len(objects)))
         by_owner: Dict[Optional[int], list] = {}
         for i, (key, t, bucket) in enumerate(objects):
             key = freeze_key(key)
@@ -192,7 +226,7 @@ class ClusterNode:
                 break
             vals = [unwire_value(v) for v in wvals]
             for (i, _), v in zip(items, vals):
-                out[i] = v
+                out[remap[i]] = v
         return out
 
     # -- incremental overlay shipping ----------------------------------
@@ -372,6 +406,10 @@ class ClusterNode:
         if self.member.seq is not None:
             return self.member.seq_ts(shards, txid)
         ts, prev = self.member.peers[0].call("m_seq", list(shards), txid)
+        # we just observed the sequencer at ts: refresh the cached
+        # frontier so our next snapshot/idle-advance doesn't stall on it
+        if ts > self.member._seq_cache:
+            self.member._seq_cache = ts
         return ts, {int(k): int(v) for k, v in prev.items()}
 
     def _abort_prepared(self, txid: int, owners) -> None:

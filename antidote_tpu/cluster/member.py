@@ -540,12 +540,11 @@ class ClusterMember:
         is read_vc[own]+1 = snapshot+1 — the same value m_commit's
         restamp rewrites to the real commit ts.
 
-        ``overlay`` is either a full wire list (legacy) or the
-        incremental form ``{"n": prefix_len, "d": prefix_digest,
-        "effs": [new wires], "nd": digest after}`` — the coordinator
-        ships only the effects the owner has not folded yet (O(N) wire
-        bytes AND folds over a txn's life, not O(N^2)).  An owner that
-        lost its cached prefix (restart, eviction) raises
+        ``overlay`` is the incremental form ``{"n": prefix_len,
+        "d": prefix_digest, "effs": [new wires], "nd": digest after}`` —
+        the coordinator ships only the effects the owner has not folded
+        yet (O(N) wire bytes AND folds over a txn's life, not O(N^2)).
+        An owner that lost its cached prefix (restart, eviction) raises
         ``overlay-resync`` and the coordinator re-sends in full.  The
         digest is a process-independent rolling CRC (python ``hash`` is
         per-process-seeded)."""
@@ -564,6 +563,10 @@ class ClusterMember:
         tvc[self.dc_id] += 1
         tvc_j = jnp.asarray(tvc, jnp.int32)
         origin = jnp.int32(self.dc_id)
+        if not isinstance(overlay, dict):
+            raise TypeError(
+                "overlay must be the incremental dict form "
+                "{'n', 'd', 'effs', 'nd'}")
         ck = (key, bucket, tvc.tobytes())
         cached = self._overlay_fold_cache.get(ck)
         n0, d0 = int(overlay["n"]), int(overlay["d"])
@@ -783,6 +786,11 @@ class ClusterMember:
         is refused — the zombie-coordinator door the takeover shut."""
         commit_vc = np.asarray(commit_vc, np.int32)
         ts = int(commit_vc[self.dc_id])
+        # an applied commit proves the sequencer reached ts: advance the
+        # cached frontier so idle-shard self-advance (and the reads
+        # waiting on it) need not wait out the 0.2 s cache refresh
+        if self.seq is None and ts > self._seq_cache:
+            self._seq_cache = ts
         with self._lock:
             if txid in self.aborted_txns:
                 raise RuntimeError(

@@ -48,19 +48,56 @@ def _percentiles(lat):
     }
 
 
-def _spawn_server(shards: int, tmp=None):
+def _env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = env.get("BENCH_PLATFORM", "cpu")
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + ":" + \
         env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn_server(shards: int):
     p = subprocess.Popen(
         [sys.executable, "-m", "antidote_tpu.console", "serve",
          "--port", "0", "--shards", str(shards), "--max-dcs", "2"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
     )
     line = p.stdout.readline().decode()
     info = json.loads(line)
-    return p, info
+    return [p], info
+
+
+def _spawn_cluster(shards: int):
+    """A 2-member DC (cluster.boot duo); clients drive member 1's port —
+    every coordinated op crosses the intra-DC RPC for half the shards."""
+    from antidote_tpu.cluster.rpc import RpcClient
+
+    procs, infos = [], []
+    try:
+        for member in (0, 1):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "antidote_tpu.cluster.boot",
+                 "--dc-id", "0", "--member", str(member), "--members", "2",
+                 "--shards", str(shards), "--max-dcs", "2"],
+                env=_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+            )
+            procs.append(p)
+        for p in procs:
+            infos.append(json.loads(p.stdout.readline().decode()))
+        peers = {m: infos[m]["rpc"] for m in (0, 1)}
+        remotes = {i["fabric_id"]: i["fabric"] for i in infos}
+        for i in infos:
+            ctl = RpcClient(*i["rpc"])
+            assert ctl.call("ctl_wire", peers, remotes, {0: 2})
+            ctl.close()
+    except BaseException:
+        # a half-booted duo must not leak (orphans hold the ports)
+        for p in procs:
+            p.kill()
+        raise
+    info = {"host": infos[1]["client"][0], "port": infos[1]["client"][1]}
+    return procs, info
 
 
 def _run_workers(n_workers, duration_s, op_fn):
@@ -98,9 +135,9 @@ HOST, PORT = "127.0.0.1", 0
 
 
 def bench_config(name, n_keys, mk_op, smoke, workers=8, read_frac=0.9,
-                 zipf=False, prepopulate=None):
+                 zipf=False, prepopulate=None, spawn=None):
     global HOST, PORT
-    p, info = _spawn_server(shards=16)
+    procs, info = (spawn or _spawn_server)(16)
     HOST, PORT = info["host"], info["port"]
     try:
         from antidote_tpu.proto.client import AntidoteClient
@@ -142,11 +179,13 @@ def bench_config(name, n_keys, mk_op, smoke, workers=8, read_frac=0.9,
         print(json.dumps(out), flush=True)
         return out
     finally:
-        p.terminate()
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def main():
@@ -154,8 +193,12 @@ def main():
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--config", type=int, default=None, help="1..4")
     ap.add_argument("--json", default=None)
+    ap.add_argument("--cluster", action="store_true",
+                    help="drive a 2-member DC instead of a single node")
     args = ap.parse_args()
     smoke = args.smoke
+    spawn = _spawn_cluster if args.cluster else None
+    tag = "_cluster" if args.cluster else ""
 
     results = []
 
@@ -168,7 +211,7 @@ def main():
             else:
                 c.update_objects([(k, "counter_pn", "b", ("increment", 1))])
 
-        results.append(bench_config("counter_pn_10k_9r1w", n, op, smoke))
+        results.append(bench_config("counter_pn_10k_9r1w" + tag, n, op, smoke, spawn=spawn))
 
     def cfg2():
         n = 1000 if smoke else 10_000
@@ -180,7 +223,7 @@ def main():
             else:
                 c.update_objects([(k, t, "b", ("assign", f"v{k}"))])
 
-        results.append(bench_config("register_lww_mv", n, op, smoke))
+        results.append(bench_config("register_lww_mv" + tag, n, op, smoke, spawn=spawn))
 
     def cfg3():
         n = 20_000 if smoke else 200_000
@@ -196,7 +239,8 @@ def main():
                                    ("remove", int(rng.integers(1 << 30))))])
 
         results.append(bench_config(
-            "set_aw_zipf_north_star", n, op, smoke, zipf=True))
+            "set_aw_zipf_north_star" + tag, n, op, smoke, zipf=True,
+            spawn=spawn))
 
     def cfg4():
         n = 500 if smoke else 2_000
@@ -211,7 +255,7 @@ def main():
                     (("name", "register_lww"), ("assign", f"u{k}")),
                 ]))])
 
-        results.append(bench_config("map_rr_nested", n, op, smoke))
+        results.append(bench_config("map_rr_nested" + tag, n, op, smoke, spawn=spawn))
 
     cfgs = {1: cfg1, 2: cfg2, 3: cfg3, 4: cfg4}
     for i, fn in sorted(cfgs.items()):

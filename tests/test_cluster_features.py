@@ -232,3 +232,35 @@ def test_overlay_resync_after_owner_cache_loss():
     vals, _ = c1.read_objects([(k, "set_aw", "b")])
     assert vals == [["b"]]
     m0.close(), m1.close()
+
+
+def test_cluster_composite_map_reads():
+    """map_rr reads through a cluster coordinator: membership + fields
+    assemble across owners, nested maps recurse, and RYW covers maps in
+    open txns."""
+    cfg = _cfg()
+    m0, m1 = _duo(cfg)
+    c1 = ClusterNode(m1)
+    c1.update_objects([("m", "map_rr", "b", ("update", {
+        ("clicks", "counter_pn"): ("increment", 4),
+        ("tags", "set_aw"): ("add", "t1"),
+        ("sub", "map_rr"): ("update", {("n", "counter_pn"):
+                                       ("increment", 1)}),
+    }))])
+    vals, _ = c1.read_objects([("m", "map_rr", "b")])
+    assert vals[0][("clicks", "counter_pn")] == 4
+    assert vals[0][("tags", "set_aw")] == ["t1"]
+    assert vals[0][("sub", "map_rr")] == {("n", "counter_pn"): 1}
+    # mixed batch: composite + plain in one read
+    c1.update_objects([("p", "counter_pn", "b", ("increment", 9))])
+    vals, _ = c1.read_objects([("p", "counter_pn", "b"),
+                               ("m", "map_rr", "b")])
+    assert vals[0] == 9 and vals[1][("clicks", "counter_pn")] == 4
+    # RYW: map updates visible inside the open txn
+    txn = c1.start_transaction()
+    c1.update_objects([("m", "map_rr", "b", ("update", {
+        ("clicks", "counter_pn"): ("increment", 1)}))], txn)
+    vals = c1.read_objects([("m", "map_rr", "b")], txn)
+    assert vals[0][("clicks", "counter_pn")] == 5
+    c1.commit_transaction(txn)
+    m0.close(), m1.close()

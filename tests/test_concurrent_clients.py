@@ -218,3 +218,49 @@ def test_connection_cap_backpressure():
     for c in holders[1:]:
         c.close()
     srv.close()
+
+
+def test_reads_monotonic_under_concurrent_writes():
+    """Value-cache coherence over the wire: while one client increments
+    a counter, other clients' reads must never go BACKWARD (a stale
+    cache entry served after a newer value was observed would violate
+    session monotonicity)."""
+    node, srv = _mk_server()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            c = AntidoteClient("127.0.0.1", srv.port)
+            for _ in range(200):
+                c.update_objects([("mono", "counter_pn", "b",
+                                   ("increment", 1))])
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+        finally:
+            stop.set()
+
+    def reader(i):
+        try:
+            c = AntidoteClient("127.0.0.1", srv.port)
+            last = -1
+            while not stop.is_set():
+                vals, _ = c.read_objects([("mono", "counter_pn", "b")])
+                v = vals[0]
+                assert v >= last, f"read went backward: {last} -> {v}"
+                last = v
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    ts = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    assert not errors, errors
+    vals, _ = node.read_objects([("mono", "counter_pn", "b")])
+    assert vals[0] == 200
+    srv.close()
