@@ -558,7 +558,8 @@ class ClusterMember:
         ty = get_type(type_name)
         ent = store.locate(key, type_name, bucket, create=False)
         cfg_k = store.table(ent[0]).cfg if ent else self.cfg
-        apply_fn = _jitted_apply(ty.name, cfg_k)
+        apply_host = getattr(ty, "apply_host", None)
+        apply_fn = None if apply_host else _jitted_apply(ty.name, cfg_k)
         tvc = np.asarray(read_vc, np.int32).copy()
         tvc[self.dc_id] += 1
         tvc_j = jnp.asarray(tvc, jnp.int32)
@@ -578,7 +579,8 @@ class ClusterMember:
             # batch): the suffix is already folded
             return jax.tree.map(np.asarray, cached[0])
         if n0 == 0:
-            state = {f: jnp.asarray(x) for f, x in state.items()}
+            if apply_host is None:
+                state = {f: jnp.asarray(x) for f, x in state.items()}
         elif (cached is not None and cached[1] == n0
                 and cached[2] == d0):
             state = cached[0]
@@ -594,14 +596,14 @@ class ClusterMember:
             # owner must intern them before value decode resolves
             for h, data in eff.blob_refs:
                 store.blobs.intern_bytes(h, data)
-            state = apply_fn(
-                state,
-                jnp.asarray(_pad_lane(
-                    eff.eff_a, ty.eff_a_width(cfg_k), np.int64)),
-                jnp.asarray(_pad_lane(
-                    eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
-                tvc_j, origin,
-            )
+            ea = _pad_lane(eff.eff_a, ty.eff_a_width(cfg_k), np.int64)
+            eb = _pad_lane(eff.eff_b, ty.eff_b_width(cfg_k), np.int32)
+            if apply_host is not None:
+                # host twin (rga): numpy ops beat per-effect dispatch
+                state = apply_host(cfg_k, state, ea, eb, tvc, self.dc_id)
+            else:
+                state = apply_fn(state, jnp.asarray(ea), jnp.asarray(eb),
+                                 tvc_j, origin)
         self._overlay_fold_cache[ck] = (state, n_total, nd)
         while len(self._overlay_fold_cache) > 512:
             self._overlay_fold_cache.popitem(last=False)

@@ -260,6 +260,13 @@ class DCReplica:
             if int(self.safe_time(shard)) > self._published_safe.get(shard, 0):
                 self.heartbeat()
                 return
+        # LIVENESS: re-ping on the wall-clock interval even with nothing
+        # new — a LOST final ping (or txn) is only ever detected by a
+        # later message on the same chain; the reference's unconditional
+        # 1 s timer provides exactly this re-send
+        # (/root/reference/src/inter_dc_log_sender_vnode.erl:133-143)
+        if time.monotonic() - self._last_hb >= self.HEARTBEAT_INTERVAL_S:
+            self.heartbeat()
 
     def safe_time(self, shard: int) -> int:
         """Largest own-lane ts such that no future local commit on
@@ -478,6 +485,29 @@ class DCReplica:
                         local = sim[shard].copy()
                         local[origin] = 0
                         if not (local >= msg.snapshot_vc).all():
+                            # dep-blocked head.  Pings QUEUED BEHIND it
+                            # may still advance this lane up to ts-1:
+                            # everything below the head's ts is applied
+                            # (chain order), so duplicate suppression
+                            # survives — and without this, two chains
+                            # can deadlock after message loss (each
+                            # head's unblocking ping trapped behind the
+                            # other's blocked head; the reference's
+                            # heartbeats advance clocks outside the
+                            # txn queue for the same reason,
+                            # /root/reference/src/inter_dc_dep_vnode.erl:122-125)
+                            # per-chain ping timestamps are monotone:
+                            # the LAST ping in the queue carries the max
+                            best = 0
+                            for m2 in reversed(q):
+                                if m2.is_ping:
+                                    best = m2.timestamp
+                                    break
+                            adv = min(best, ts - 1)
+                            if adv > sim[shard, origin]:
+                                sim[shard, origin] = adv
+                                advances.append((shard, origin, adv))
+                                progressed = True
                             break
                         batch.append((msg, origin))
                         sim[shard, origin] = ts
@@ -486,6 +516,13 @@ class DCReplica:
                         progressed = True
                     taken[gk] = i
             if not batch and not advances:
+                # still consume the examined prefix (duplicates, stale
+                # pings): leaving it queued forever is a leak AND makes
+                # every later drain rescan it
+                for gk, n in taken.items():
+                    q = self.gate[gk]
+                    for _ in range(n):
+                        q.popleft()
                 return
             if batch:
                 effects, vcs, origins = [], [], []

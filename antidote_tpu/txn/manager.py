@@ -38,7 +38,8 @@ from antidote_tpu.clock import vector as vcm
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import TYPES, get_type, is_type
 from antidote_tpu.store.kv import BoundObject, Effect, KVStore
-
+from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
+from antidote_tpu.txn.hooks import HookRegistry
 
 @functools.lru_cache(maxsize=1)
 def _composite_names() -> frozenset:
@@ -54,8 +55,7 @@ def _jitted_apply(ty_name: str, cfg: AntidoteConfig):
     primitives per effect (the rga populate hot spot)."""
     ty = get_type(ty_name)
     return jax.jit(functools.partial(ty.apply, cfg))
-from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
-from antidote_tpu.txn.hooks import HookRegistry
+
 
 Update = Tuple[Any, str, str, Tuple[str, Any]]  # (key, type_name, bucket, op)
 
@@ -591,28 +591,45 @@ class TransactionManager:
             # wider state; pending effect lanes pad up to match)
             ent = self.store.locate(key, type_name, bucket, create=False)
             cfg_k = self.store.table(ent[0]).cfg if ent else self.cfg
-            apply_fn = _jitted_apply(ty.name, cfg_k)
+            apply_host = getattr(ty, "apply_host", None)
             dk = (key, bucket)
             cached = txn.overlay_cache.get(dk)
             if cached is not None and cached[1] <= len(pend):
                 state, done = cached
             else:
-                state = {f: jnp.asarray(x) for f, x in states[i].items()}
+                state = states[i]
+                if apply_host is None:
+                    state = {f: jnp.asarray(x) for f, x in state.items()}
                 done = 0
-            for eff in pend[done:]:
-                state = apply_fn(
-                    state,
-                    jnp.asarray(_pad_lane(
-                        eff.eff_a, ty.eff_a_width(cfg_k), np.int64)),
-                    jnp.asarray(_pad_lane(
-                        eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
-                    tvc,
-                    origin,
-                )
+            if apply_host is not None:
+                # host twin (e.g. rga): a few numpy ops per effect beat a
+                # compiled-fn dispatch on the per-op overlay path
+                tvc_np = np.asarray(txn.tentative_vc, np.int32)
+                for eff in pend[done:]:
+                    state = apply_host(
+                        cfg_k, state,
+                        _pad_lane(eff.eff_a, ty.eff_a_width(cfg_k),
+                                  np.int64),
+                        _pad_lane(eff.eff_b, ty.eff_b_width(cfg_k),
+                                  np.int32),
+                        tvc_np, self.my_dc,
+                    )
+            else:
+                apply_fn = _jitted_apply(ty.name, cfg_k)
+                for eff in pend[done:]:
+                    state = apply_fn(
+                        state,
+                        jnp.asarray(_pad_lane(
+                            eff.eff_a, ty.eff_a_width(cfg_k), np.int64)),
+                        jnp.asarray(_pad_lane(
+                            eff.eff_b, ty.eff_b_width(cfg_k), np.int32)),
+                        tvc,
+                        origin,
+                    )
             txn.overlay_cache[dk] = (state, len(pend))
-            # hand back the device-resident overlaid state: consumers
-            # (downstream generators, value decoders) np.asarray only the
-            # fields they touch — converting all of them eagerly was the
-            # rga populate hot spot
+            # hand back the overlaid state as-is (device arrays for
+            # jitted types, host numpy for apply_host types): consumers
+            # np.asarray only the fields they touch — converting all of
+            # them eagerly was the rga populate hot spot
             states[i] = state
         return states

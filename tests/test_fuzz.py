@@ -1,0 +1,131 @@
+"""Randomized multi-DC convergence fuzzing.
+
+The reference's CT suites drive fixed scenarios; this adds seeded random
+op tapes over a 3-DC mesh with random pump interleavings and random
+message loss (healed by the opid-gap catch-up protocol), asserting:
+
+  * CONVERGENCE: after quiescence every DC reads identical values at
+    the global max clock;
+  * counter oracle: totals equal the sum of all increments everywhere;
+  * set bounds: an element added somewhere and never removed anywhere
+    is present; an element never added is absent;
+  * lww registers: converged to SOME assigned value.
+"""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.interdc import DCReplica, LoopbackHub
+
+
+def _cfg():
+    return AntidoteConfig(n_shards=4, max_dcs=3, ops_per_key=8,
+                          snap_versions=2, set_slots=16,
+                          keys_per_table=64, batch_buckets=(16, 64))
+
+
+@pytest.mark.parametrize("seed,lossy", [(1, False), (2, False),
+                                        (3, True), (4, True),
+                                        (5, True), (6, True)])
+def test_random_ops_converge(seed, lossy):
+    rng = np.random.default_rng(seed)
+    hub = LoopbackHub()
+    nodes = [AntidoteNode(_cfg(), dc_id=i) for i in range(3)]
+    reps = [DCReplica(n, hub, f"dc{i}") for i, n in enumerate(nodes)]
+    DCReplica.connect_all(reps)
+    for r in reps:
+        # fast re-ping so lossy trials heal within the quiesce loop (the
+        # liveness re-send is wall-clock-driven, 1 s in production)
+        r.HEARTBEAT_INTERVAL_S = 0.05
+    counters = [f"c{i}" for i in range(4)]
+    sets = [f"s{i}" for i in range(4)]
+    regs = [f"r{i}" for i in range(2)]
+    inc_total = {k: 0 for k in counters}
+    added, removed, assigned = set(), set(), set()
+
+    for step in range(120):
+        dc = int(rng.integers(3))
+        node = nodes[dc]
+        kind = rng.random()
+        try:
+            if kind < 0.4:
+                k = counters[int(rng.integers(len(counters)))]
+                n = int(rng.integers(1, 9))
+                node.update_objects([(k, "counter_pn", "b",
+                                      ("increment", n))])
+                inc_total[k] += n
+            elif kind < 0.7:
+                k = sets[int(rng.integers(len(sets)))]
+                e = f"e{int(rng.integers(12))}"
+                node.update_objects([(k, "set_aw", "b", ("add", e))])
+                added.add((k, e))
+            elif kind < 0.85:
+                k = sets[int(rng.integers(len(sets)))]
+                e = f"e{int(rng.integers(12))}"
+                node.update_objects([(k, "set_aw", "b", ("remove", e))])
+                removed.add((k, e))
+            else:
+                k = regs[int(rng.integers(len(regs)))]
+                v = f"v{step}"
+                node.update_objects([(k, "register_lww", "b",
+                                      ("assign", v))])
+                assigned.add((k, v))
+        except Exception:
+            raise
+        if lossy and rng.random() < 0.15:
+            # drop the next message on a random directed link; the
+            # opid-gap catch-up must heal it
+            a, b = rng.choice(3, size=2, replace=False)
+            hub.drop_next(int(a), int(b), 1)
+        if rng.random() < 0.3:
+            hub.pump()
+
+    # quiesce: pump until every DC's clock converged (lost FINAL
+    # messages heal via the wall-clock re-ping, so pace the loop past
+    # the interval)
+    import time as _t
+
+    for _ in range(120):
+        hub.pump()
+        clocks = [n.store.dc_max_vc() for n in nodes]
+        stables = [n.store.stable_vc() for n in nodes]
+        tgt = np.max(np.stack(clocks), axis=0)
+        if all((c == tgt).all() for c in clocks) and \
+                all((s >= tgt).all() for s in stables):
+            break
+        _t.sleep(0.06)
+    else:
+        raise AssertionError(
+            f"never converged: clocks={clocks} stables={stables}")
+    target = np.max(np.stack([n.store.dc_max_vc() for n in nodes]), axis=0)
+    objs = ([(k, "counter_pn", "b") for k in counters]
+            + [(k, "set_aw", "b") for k in sets]
+            + [(k, "register_lww", "b") for k in regs])
+    reads = []
+    for n in nodes:
+        vals, _ = n.read_objects(objs, clock=target)
+        reads.append(vals)
+    # convergence
+    assert reads[0] == reads[1] == reads[2], (seed, lossy, reads)
+    vals = reads[0]
+    # counter oracle
+    for j, k in enumerate(counters):
+        assert vals[j] == inc_total[k], (k, vals[j], inc_total[k])
+    # set bounds
+    off = len(counters)
+    for j, k in enumerate(sets):
+        got = set(vals[off + j])
+        must = {e for (kk, e) in added
+                if kk == k and (kk, e) not in removed}
+        assert must <= got, (k, "missing", must - got)
+        never_added = got - {e for (kk, e) in added if kk == k}
+        assert not never_added, (k, "phantom", never_added)
+    # registers: some assigned value (or empty if never assigned)
+    off = len(counters) + len(sets)
+    for j, k in enumerate(regs):
+        v = vals[off + j]
+        opts = {vv for (kk, vv) in assigned if kk == k}
+        if opts:
+            assert v in opts, (k, v, opts)
