@@ -373,6 +373,12 @@ class ClusterNode:
             # cert conflicts raise "abort: ..." — locally as RuntimeError,
             # remotely surfaced through RpcError (a RuntimeError subclass)
             self._abort_prepared(txn.txid, prepared)
+            # a conflict means another coordinator committed past our
+            # snapshot: invalidate the cached sequencer frontier so the
+            # client's RETRY starts from a snapshot that can pass
+            # certification instead of re-aborting for up to the whole
+            # cache-refresh window
+            self.member.invalidate_seq_cache()
             if "abort" in str(e):
                 raise AbortError(str(e)) from e
             raise

@@ -468,6 +468,12 @@ class ClusterMember:
         vc = self.node.store.applied_vc
         return [(s, [int(x) for x in vc[s]]) for s in sorted(self.shards)]
 
+    def invalidate_seq_cache(self) -> None:
+        """Force the next ``_seq_counter`` to refresh from the sequencer
+        (called after a certification abort: the conflict proves the
+        frontier moved past our cached view)."""
+        self._seq_cache_at = 0.0
+
     def _seq_counter(self) -> int:
         """The DC timestamp frontier (locally for the sequencer, cached
         RPC otherwise)."""
@@ -562,8 +568,9 @@ class ClusterMember:
         apply_fn = None if apply_host else _jitted_apply(ty.name, cfg_k)
         tvc = np.asarray(read_vc, np.int32).copy()
         tvc[self.dc_id] += 1
-        tvc_j = jnp.asarray(tvc, jnp.int32)
-        origin = jnp.int32(self.dc_id)
+        if apply_host is None:
+            tvc_j = jnp.asarray(tvc, jnp.int32)
+            origin = jnp.int32(self.dc_id)
         if not isinstance(overlay, dict):
             raise TypeError(
                 "overlay must be the incremental dict form "

@@ -49,31 +49,26 @@ def test_random_ops_converge(seed, lossy):
         dc = int(rng.integers(3))
         node = nodes[dc]
         kind = rng.random()
-        try:
-            if kind < 0.4:
-                k = counters[int(rng.integers(len(counters)))]
-                n = int(rng.integers(1, 9))
-                node.update_objects([(k, "counter_pn", "b",
-                                      ("increment", n))])
-                inc_total[k] += n
-            elif kind < 0.7:
-                k = sets[int(rng.integers(len(sets)))]
-                e = f"e{int(rng.integers(12))}"
-                node.update_objects([(k, "set_aw", "b", ("add", e))])
-                added.add((k, e))
-            elif kind < 0.85:
-                k = sets[int(rng.integers(len(sets)))]
-                e = f"e{int(rng.integers(12))}"
-                node.update_objects([(k, "set_aw", "b", ("remove", e))])
-                removed.add((k, e))
-            else:
-                k = regs[int(rng.integers(len(regs)))]
-                v = f"v{step}"
-                node.update_objects([(k, "register_lww", "b",
-                                      ("assign", v))])
-                assigned.add((k, v))
-        except Exception:
-            raise
+        if kind < 0.4:
+            k = counters[int(rng.integers(len(counters)))]
+            n = int(rng.integers(1, 9))
+            node.update_objects([(k, "counter_pn", "b", ("increment", n))])
+            inc_total[k] += n
+        elif kind < 0.7:
+            k = sets[int(rng.integers(len(sets)))]
+            e = f"e{int(rng.integers(12))}"
+            node.update_objects([(k, "set_aw", "b", ("add", e))])
+            added.add((k, e))
+        elif kind < 0.85:
+            k = sets[int(rng.integers(len(sets)))]
+            e = f"e{int(rng.integers(12))}"
+            node.update_objects([(k, "set_aw", "b", ("remove", e))])
+            removed.add((k, e))
+        else:
+            k = regs[int(rng.integers(len(regs)))]
+            v = f"v{step}"
+            node.update_objects([(k, "register_lww", "b", ("assign", v))])
+            assigned.add((k, v))
         if lossy and rng.random() < 0.15:
             # drop the next message on a random directed link; the
             # opid-gap catch-up must heal it
@@ -129,3 +124,158 @@ def test_random_ops_converge(seed, lossy):
         opts = {vv for (kk, vv) in assigned if kk == k}
         if opts:
             assert v in opts, (k, v, opts)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_random_ops_survive_crash_recovery(seed, tmp_path):
+    """Seeded random single-node tape with a crash (WAL-only restart)
+    mid-tape: the recovered node must answer every key exactly as the
+    pre-crash node would, and keep accepting ops afterwards."""
+    rng = np.random.default_rng(seed)
+    cfg = _cfg()
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(cfg, log_dir=log_dir)
+    model_cnt = {}
+    model_set_add = {}
+    model_set_rm = {}
+
+    def random_op(n):
+        kind = rng.random()
+        if kind < 0.45:
+            k = f"c{int(rng.integers(5))}"
+            amt = int(rng.integers(1, 9))
+            n.update_objects([(k, "counter_pn", "b", ("increment", amt))])
+            model_cnt[k] = model_cnt.get(k, 0) + amt
+        elif kind < 0.8:
+            k = f"s{int(rng.integers(5))}"
+            e = f"e{int(rng.integers(10))}"
+            n.update_objects([(k, "set_aw", "b", ("add", e))])
+            model_set_add.setdefault(k, set()).add(e)
+            model_set_rm.setdefault(k, set()).discard(e)
+        else:
+            k = f"s{int(rng.integers(5))}"
+            e = f"e{int(rng.integers(10))}"
+            n.update_objects([(k, "set_aw", "b", ("remove", e))])
+            # sequential single node: remove observes everything prior
+            model_set_rm.setdefault(k, set()).add(e)
+            model_set_add.setdefault(k, set()).discard(e)
+
+    for _ in range(60):
+        random_op(node)
+    node.store.log.close()  # crash
+
+    node2 = AntidoteNode(cfg, log_dir=log_dir, recover=True)
+    objs = ([(k, "counter_pn", "b") for k in sorted(model_cnt)]
+            + [(k, "set_aw", "b") for k in sorted(model_set_add)])
+    vals, _ = node2.read_objects(objs)
+    i = 0
+    for k in sorted(model_cnt):
+        assert vals[i] == model_cnt[k], (k, vals[i], model_cnt[k])
+        i += 1
+    for k in sorted(model_set_add):
+        assert set(vals[i]) == model_set_add.get(k, set()), (k, vals[i])
+        i += 1
+    # and the recovered node keeps working (chains continue)
+    for _ in range(20):
+        random_op(node2)
+    vals, _ = node2.read_objects(objs)
+    i = 0
+    for k in sorted(model_cnt):
+        assert vals[i] == model_cnt[k]
+        i += 1
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_random_ops_cluster_coordinators(seed):
+    """Seeded random tape against a 2-member DC with the coordinator
+    chosen at random per op (sequencer chains, owner routing, RYW txns):
+    final reads agree between coordinators and match the oracle."""
+    from antidote_tpu.cluster import ClusterMember, ClusterNode
+
+    rng = np.random.default_rng(seed)
+    cfg = _cfg()
+    m0 = ClusterMember(cfg, dc_id=0, member_id=0, n_members=2)
+    m1 = ClusterMember(cfg, dc_id=0, member_id=1, n_members=2)
+    m0.connect(1, *m1.address)
+    m1.connect(0, *m0.address)
+    coords = [ClusterNode(m0), ClusterNode(m1)]
+    model_cnt = {}
+    model_set = {}
+
+    def commit_retrying(c, updates, tries=10):
+        # a fresh coordinator's snapshot may trail another coordinator's
+        # just-committed ts by the seq-cache staleness window: first-
+        # committer-wins aborts it, the client retries (the reference's
+        # clients do the same on {aborted, ...})
+        from antidote_tpu.txn.manager import AbortError as _Abort
+
+        for _ in range(tries):
+            try:
+                c.update_objects(updates)
+                return
+            except _Abort:
+                import time as _t
+
+                _t.sleep(0.02)
+        raise AssertionError(f"aborted {tries} times: {updates}")
+
+    try:
+        for step in range(60):
+            c = coords[int(rng.integers(2))]
+            kind = rng.random()
+            if kind < 0.4:
+                k = f"c{int(rng.integers(4))}"
+                amt = int(rng.integers(1, 9))
+                commit_retrying(c, [(k, "counter_pn", "b",
+                                     ("increment", amt))])
+                model_cnt[k] = model_cnt.get(k, 0) + amt
+            elif kind < 0.7:
+                k = f"s{int(rng.integers(4))}"
+                e = f"e{int(rng.integers(8))}"
+                commit_retrying(c, [(k, "set_aw", "b", ("add", e))])
+                model_set.setdefault(k, set()).add(e)
+            elif kind < 0.85:
+                k = f"s{int(rng.integers(4))}"
+                e = f"e{int(rng.integers(8))}"
+                commit_retrying(c, [(k, "set_aw", "b", ("remove", e))])
+                model_set.setdefault(k, set()).discard(e)
+            else:
+                # interactive multi-key txn with RYW check (retried on
+                # cert aborts like any interactive client)
+                from antidote_tpu.txn.manager import AbortError as _Abort
+
+                k1, k2 = f"c{int(rng.integers(4))}", f"s{int(rng.integers(4))}"
+                for _ in range(10):
+                    txn = c.start_transaction()
+                    try:
+                        c.update_objects(
+                            [(k1, "counter_pn", "b", ("increment", 2)),
+                             (k2, "set_aw", "b", ("add", "T"))], txn)
+                        v = c.read_objects([(k1, "counter_pn", "b")], txn)
+                        assert v[0] == model_cnt.get(k1, 0) + 2
+                        c.commit_transaction(txn)
+                        break
+                    except _Abort:
+                        import time as _t
+
+                        _t.sleep(0.02)
+                else:
+                    raise AssertionError("interactive txn aborted 10x")
+                model_cnt[k1] = model_cnt.get(k1, 0) + 2
+                model_set.setdefault(k2, set()).add("T")
+        objs = ([(k, "counter_pn", "b") for k in sorted(model_cnt)]
+                + [(k, "set_aw", "b") for k in sorted(model_set)])
+        reads = []
+        for c in coords:
+            vals, _ = c.read_objects(objs)
+            reads.append(vals)
+        assert reads[0] == reads[1], (seed, reads)
+        i = 0
+        for k in sorted(model_cnt):
+            assert reads[0][i] == model_cnt[k], (k, reads[0][i])
+            i += 1
+        for k in sorted(model_set):
+            assert set(reads[0][i]) == model_set[k], (k, reads[0][i])
+            i += 1
+    finally:
+        m0.close(), m1.close()
