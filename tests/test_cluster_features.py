@@ -264,3 +264,82 @@ def test_cluster_composite_map_reads():
     assert vals[0][("clicks", "counter_pn")] == 5
     c1.commit_transaction(txn)
     m0.close(), m1.close()
+
+
+def test_offline_membership_resize(tmp_path):
+    """DC membership change 2 -> 3 members via the offline resize tool:
+    write through a 2-member cluster, quiesce, resize the log dirs,
+    boot 3 members with --recover, and verify every value plus new
+    commits on the grown cluster (then shrink 3 -> 1 and re-verify)."""
+    from antidote_tpu.cluster.resize import resize_dc
+
+    cfg = _cfg()
+    old = [str(tmp_path / f"m{i}") for i in range(2)]
+    m0 = ClusterMember(cfg, dc_id=0, member_id=0, n_members=2,
+                       log_dir=old[0])
+    m1 = ClusterMember(cfg, dc_id=0, member_id=1, n_members=2,
+                       log_dir=old[1])
+    m0.connect(1, *m1.address)
+    m1.connect(0, *m0.address)
+    live = [m0, m1]
+
+    def shutdown(members):
+        for m in members:
+            if m.node.store.log is not None:
+                m.node.store.log.close()
+            m._prep_wal.close()
+            m.rpc.close()
+        live.clear()
+
+    try:
+        c = ClusterNode(m1)
+        expect = {}
+        for i in range(12):
+            c.update_objects([(f"k{i}", "counter_pn", "b",
+                               ("increment", i + 1)),
+                              (f"s{i}", "set_aw", "b", ("add", f"e{i}"))])
+            expect[(f"k{i}", "counter_pn", "b")] = i + 1
+            expect[(f"s{i}", "set_aw", "b")] = [f"e{i}"]
+        shutdown([m0, m1])
+
+        new = [str(tmp_path / f"n{i}") for i in range(3)]
+        resize_dc(old, new, dc_id=0)
+
+        ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=3,
+                            log_dir=new[i], recover=True) for i in range(3)]
+        live.extend(ms)
+        for i, m in enumerate(ms):
+            for j, o in enumerate(ms):
+                if i != j:
+                    m.connect(j, *o.address)
+        c3 = ClusterNode(ms[1])
+        vals, _ = c3.read_objects(list(expect))
+        for (obj, want), got in zip(expect.items(), vals):
+            assert got == want, (obj, got, want)
+        # the grown cluster accepts new commits (chains continue)
+        vc = c3.update_objects([("k0", "counter_pn", "b",
+                                 ("increment", 100))])
+        assert vc[0] > 0
+        vals, _ = ClusterNode(ms[0]).read_objects([("k0", "counter_pn",
+                                                    "b")])
+        assert vals == [101]
+        expect[("k0", "counter_pn", "b")] = 101
+        shutdown(ms)
+
+        # shrink 3 -> 1: the single member owns everything
+        solo = [str(tmp_path / "solo")]
+        resize_dc(new, solo, dc_id=0)
+        m = ClusterMember(cfg, dc_id=0, member_id=0, n_members=1,
+                          log_dir=solo[0], recover=True)
+        live.append(m)
+        c1 = ClusterNode(m)
+        vals, _ = c1.read_objects(list(expect))
+        for (obj, want), got in zip(expect.items(), vals):
+            assert got == want, (obj, got, want)
+        c1.update_objects([("k1", "counter_pn", "b", ("increment", 1))])
+    finally:
+        for m in live:
+            try:
+                m.close()
+            except Exception:
+                pass
