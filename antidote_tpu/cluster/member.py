@@ -640,6 +640,12 @@ class ClusterMember:
                         timeout: float = 30.0) -> None:
         import time as _t
 
+        # the requested own-lane ts was derived from the sequencer
+        # (stable/session/frontier), so it IS a frontier lower bound:
+        # adopt it instead of stalling up to the cache-refresh window
+        # waiting for idle-advance to learn the same number
+        if self.seq is None and want_ts > self._seq_cache:
+            self._seq_cache = want_ts
         deadline = _t.monotonic() + timeout
         while True:
             self.advance_idle_shards()

@@ -31,8 +31,6 @@ def resize_dc(old_dirs: List[str], new_dirs: List[str], dc_id: int = 0
               ) -> None:
     import os
 
-    import numpy as np
-
     from antidote_tpu.api.node import AntidoteNode
     from antidote_tpu.config import AntidoteConfig
     from antidote_tpu.log import load_dir_meta
@@ -51,7 +49,6 @@ def resize_dc(old_dirs: List[str], new_dirs: List[str], dc_id: int = 0
     cfg = AntidoteConfig(n_shards=meta["n_shards"], max_dcs=meta["max_dcs"])
 
     # ---- quiescence gate: no staged-but-undecided txns anywhere
-    seq_records = []
     for d in old_dirs:
         prep = os.path.join(d, "prepare.wal")
         if not os.path.exists(prep):
@@ -64,44 +61,62 @@ def resize_dc(old_dirs: List[str], new_dirs: List[str], dc_id: int = 0
                 staged[txid] = True
             elif ev in ("commit", "abort"):
                 staged.pop(txid, None)
-            elif ev == "seq":
-                seq_records.append(rec)
         if staged:
             raise RuntimeError(
                 f"{d!r} holds staged-but-undecided txns {sorted(staged)}; "
                 "settle them first (console cluster-resolve / "
                 "cluster-sweep on the live cluster)")
 
-    # ---- load old stores, build new nodes
-    old_nodes = [AntidoteNode(cfg, dc_id=dc_id, log_dir=d, recover=True)
-                 for d in old_dirs]
+    # ---- recover old members through the FULL member machinery: a crash
+    # between the durable commit record and the store apply leaves the
+    # effects only in prepare.wal, and _replay_recovered_commits is what
+    # re-applies them — a bare store-WAL replay would silently drop an
+    # acknowledged commit
+    from antidote_tpu.cluster.member import ClusterMember
+
+    old_members = [
+        ClusterMember(cfg, dc_id=dc_id, member_id=i, n_members=n_old,
+                      log_dir=d, recover=True)
+        for i, d in enumerate(old_dirs)
+    ]
     new_nodes = [AntidoteNode(cfg, dc_id=dc_id, log_dir=d)
                  for d in new_dirs]
 
     # ---- move every shard to its new owner
     for s in range(cfg.n_shards):
-        src = old_nodes[s % n_old]
+        src = old_members[s % n_old].node
         dst = new_nodes[s % n_new]
         pkg = handoff.export_shard(src.store, s)
         handoff.import_shard(dst.store, pkg)
 
-    # ---- sequencer ledger -> new member 0's prepare log
+    # ---- sequencer floor for the new member 0: per-shard last-ts =
+    # the old OWNER's applied frontier (NOT the old ledger's last issued
+    # ts: a takeover-aborted hole is closed only by an in-memory no-op
+    # link, so carrying the issued ts would wedge the first post-resize
+    # commit behind a prev no one can reach)
     from antidote_tpu.log.wal import ShardWAL
 
-    max_ts = max((int(np.asarray(n.store.applied_vc)[:, dc_id].max())
-                  for n in old_nodes), default=0)
     w = ShardWAL(os.path.join(new_dirs[0], "prepare.wal"))
-    # counter floor first: even if old seq records were compacted away,
-    # the restored sequencer can never re-issue an applied ts
+    max_ts = 0
+    for s in range(cfg.n_shards):
+        owner = old_members[s % n_old]
+        ts_s = int(owner.applied_ts.get(s, 0))
+        max_ts = max(max_ts, ts_s)
+        if ts_s > 0:
+            w.append({"ev": "seq", "ts": ts_s, "txid": 0,
+                      "shards": [int(s)], "prev": {}})
+    # counter floor covers lanes with no per-shard record
     w.append({"ev": "seq", "ts": int(max_ts), "txid": 0, "shards": [],
               "prev": {}})
-    for rec in seq_records:
-        w.append(rec)
     w.commit()
     w.sync()
     w.close()
 
-    for n in old_nodes + new_nodes:
+    for m in old_members:
+        m.close()
+        if m.node.store.log is not None:
+            m.node.store.log.close()
+    for n in new_nodes:
         if n.store.log is not None:
             n.store.log.close()
 
