@@ -40,9 +40,11 @@ def main(argv=None) -> int:
                     help="rejoin: replay the WAL + prepare log")
     args = ap.parse_args(argv)
 
-    from antidote_tpu.config import apply_jax_platform_env
+    from antidote_tpu.config import (apply_jax_platform_env,
+                                 enable_compilation_cache)
 
     apply_jax_platform_env()
+    enable_compilation_cache()
 
     from antidote_tpu.cluster import (ClusterMember, ClusterNode,
                                       attach_interdc, cluster_query_router)

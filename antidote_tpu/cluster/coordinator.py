@@ -36,13 +36,17 @@ from antidote_tpu.txn.manager import AbortError
 
 
 class ClusterTxn:
-    # Seeded with the boot time in microseconds (40 bits): txids must be
+    # Seeded with the boot time in microseconds (48 bits): txids must be
     # unique across coordinators AND across process restarts — the
     # takeover outcome tables (committed/aborted/resolutions) are durable
     # and keyed by txid, so a restarted coordinator reusing an old txid
     # would inherit a dead transaction's fate.  Time advances faster than
-    # any coordinator issues txns, so each boot's range is disjoint.
-    _ids = itertools.count(time.time_ns() // 1000 & ((1 << 40) - 1))
+    # any coordinator issues txns, so each boot's range is disjoint;
+    # 48 bits of microseconds wrap only every ~8.9 YEARS (a 40-bit mask
+    # wrapped every ~12.7 days, which could alias a long-lived
+    # deployment's earlier boot — r4 advisor), and the coord_tag << 56
+    # tag still leaves 8 bits of headroom above the counter.
+    _ids = itertools.count(time.time_ns() // 1000 & ((1 << 48) - 1))
 
     def __init__(self, snapshot_vc: np.ndarray, coord_tag: int):
         self.txid = (coord_tag << 56) | next(ClusterTxn._ids)
@@ -242,7 +246,7 @@ class ClusterNode:
         wires = [eff_to_wire(e) for e in pend[n0:]]
         nd = overlay_digest(d0, wires)
         return {"n": n0, "d": d0, "effs": wires, "nd": nd,
-                "_total": len(pend)}
+                "txid": txn.txid, "_total": len(pend)}
 
     @staticmethod
     def _overlay_mark_sent(txn: ClusterTxn, objs, overlays) -> None:

@@ -105,8 +105,10 @@ class Sequencer:
         self.chain: Dict[int, List[Tuple[int, int]]] = {}
         #: txid -> ts (was this txn ever issued a ts? bounded like issued)
         self.txid_index: "OrderedDict[int, int]" = OrderedDict()
-        #: txid -> takeover decision tuple (idempotent re-resolution)
-        self.resolutions: Dict[int, tuple] = {}
+        #: txid -> takeover decision tuple (idempotent re-resolution);
+        #: trimmed to _LEDGER_CAP like every other outcome ledger —
+        #: stickiness is already best-effort once those GC (r4 advisor)
+        self.resolutions: "OrderedDict[int, tuple]" = OrderedDict()
 
     def next_ts(self, shards, txid: int = 0) -> Tuple[int, Dict[int, int]]:
         with self._lock:
@@ -129,6 +131,11 @@ class Sequencer:
             while len(self.txid_index) > _LEDGER_CAP:
                 self.txid_index.popitem(last=False)
             return ts, prev
+
+    def trim_resolutions(self) -> None:
+        with self._lock:
+            while len(self.resolutions) > _LEDGER_CAP:
+                self.resolutions.popitem(last=False)
 
     def restore_issue(self, ts: int, txid: int, shards, prev) -> None:
         """Rebuild one ledger entry from the prepare log (recovery).
@@ -575,7 +582,12 @@ class ClusterMember:
             raise TypeError(
                 "overlay must be the incremental dict form "
                 "{'n', 'd', 'effs', 'nd'}")
-        ck = (key, bucket, tvc.tobytes())
+        # the txid in the key means two txns sharing a (key, bucket,
+        # snapshot) can never alias each other's fold prefix, whatever
+        # the 32-bit digest says (r4 advisor); overlays from pre-txid
+        # coordinators fall into a shared 0 lane, where the digest still
+        # gates as before
+        ck = (key, bucket, tvc.tobytes(), int(overlay.get("txid", 0)))
         cached = self._overlay_fold_cache.get(ck)
         n0, d0 = int(overlay["n"]), int(overlay["d"])
         wires, nd = overlay["effs"], int(overlay["nd"])
@@ -950,6 +962,7 @@ class ClusterMember:
         dec = self._decide(txid, ts, tx_shards, prev, t_issued, grace_s)
         if dec is not None and dec[0] != "wait":
             self.seq.resolutions[txid] = tuple(dec)
+            self.seq.trim_resolutions()
             if dec[0] == "commit":
                 # complete the dead coordinator's fan-out: every member
                 # holding the staged write-set applies it now
@@ -1048,6 +1061,7 @@ class ClusterMember:
                 pass
         dec = ["abort", txid, 0]
         self.seq.resolutions[txid] = tuple(dec)
+        self.seq.trim_resolutions()
         return dec
 
     def sweep_stale_prepared(self, grace_s: float = 30.0) -> int:

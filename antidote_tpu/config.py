@@ -88,3 +88,30 @@ def apply_jax_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", want)
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Turn on JAX's persistent XLA compile cache for this process.
+
+    The serving fns compile per (type, batch-bucket, fold-window) shape;
+    a cold server pays seconds of compile debt as traffic discovers the
+    shape family, which is exactly the latency-tail profile a database
+    must not have (the BEAM reference has no such debt — its hot paths
+    are interpreted).  With the on-disk cache, every antidote process on
+    the host (server restarts, cluster members, test subprocesses) warms
+    from the first process's compiles.  Override the location with
+    ``ANTIDOTE_XLA_CACHE``; disable with ``ANTIDOTE_XLA_CACHE=off``."""
+    import os
+
+    path = path or os.environ.get("ANTIDOTE_XLA_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "antidote_tpu_xla"
+    )
+    if path == "off":
+        return
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    os.makedirs(path, exist_ok=True)
+    cc.set_cache_dir(path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -57,9 +57,11 @@ def resolve_serve_shape(log_dir, shards, max_dcs):
 def cmd_serve(args) -> int:
     import os
 
-    from antidote_tpu.config import apply_jax_platform_env
+    from antidote_tpu.config import (apply_jax_platform_env,
+                                 enable_compilation_cache)
 
     apply_jax_platform_env()
+    enable_compilation_cache()
 
     from antidote_tpu.api import AntidoteNode
     from antidote_tpu.config import AntidoteConfig
@@ -67,7 +69,8 @@ def cmd_serve(args) -> int:
 
     shards, max_dcs = resolve_serve_shape(args.log_dir, args.shards,
                                           args.max_dcs)
-    cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs)
+    cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs,
+                         keys_per_table=args.keys_per_table)
     has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and any(
         f.endswith(".wal") and os.path.getsize(os.path.join(args.log_dir, f)) > 0
         for f in os.listdir(args.log_dir)
@@ -241,6 +244,11 @@ def main(argv=None) -> int:
     sv.add_argument("--max-dcs", type=int, default=None,
                     help="default: the log dir's recorded shape, else 8")
     sv.add_argument("--recover", action="store_true")
+    sv.add_argument("--keys-per-table", type=int, default=4096,
+                    help="initial rows per (type, shard); size near the "
+                         "expected keyspace — every growth doubling "
+                         "reallocates the device tables and recompiles "
+                         "all serving shapes")
     sv.set_defaults(fn=cmd_serve)
 
     for name, fn in (("status", cmd_status), ("ready", cmd_ready)):

@@ -50,7 +50,16 @@ class RGA(CRDTType):
 
     def stamp_op_seq(self, eff_a, eff_b, seq: int):
         # the txn layer numbers a key's effects within the txn; the lane
-        # disambiguates uids of same-commit inserts
+        # disambiguates uids of same-commit inserts.  The uid layout
+        # gives the seq 16 bits (bits 8-23, see _make_uid) — a txn
+        # issuing more ops than that on ONE rga key would silently
+        # overflow seq into the ts field and corrupt uid ordering, so
+        # fail loudly instead (r4 advisor).
+        if seq >= 1 << 16:
+            raise OverflowError(
+                "rga: a single transaction may issue at most 65535 "
+                f"operations per key (got op #{seq})"
+            )
         eff_b = np.array(eff_b, copy=True)
         eff_b[1] = seq
         return eff_a, eff_b

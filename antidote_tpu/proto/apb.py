@@ -422,9 +422,47 @@ def handle_request(server, code: int, payload: bytes, conn_txns: set,
         req = decode_msg(name, payload)  # outside the lock
     except Exception as e:
         return _error(f"{type(e).__name__}: {e}")
+    if name in ("ApbStaticReadObjects", "ApbStaticUpdateObjects"):
+        # static ops ride the server's gate helpers (batched: the gate's
+        # dispatcher thread takes the lock; unbatched: they lock inline)
+        # — the only static dispatch path, so it cannot drift from a
+        # duplicate branch in _dispatch
+        resp_name, resp = _dispatch_static(server, name, req)
+        return encode_frame_body(resp_name, resp)
     with (lock if lock is not None else contextlib.nullcontext()):
         resp_name, resp = _dispatch(server, name, req, conn_txns)
     return encode_frame_body(resp_name, resp)  # outside the lock
+
+
+def _dispatch_static(server, name: str, req: Dict[str, Any]):
+    node = server.node
+    my_dc = getattr(node, "dc_id", 0)
+    try:
+        if name == "ApbStaticUpdateObjects":
+            clock = _dec_clock(req["transaction"].get("timestamp"))
+            vc = server.static_update(
+                updates_from_update_ops(req.get("updates", []), my_dc), clock
+            )
+            return "ApbCommitResp", {
+                "success": True, "commit_time": _enc_clock(vc),
+            }
+        clock = _dec_clock(req["transaction"].get("timestamp"))
+        objs = [_bound_object(bo) for bo in req.get("objects", [])]
+        vals, vc = server.static_read(objs, clock)
+        return "ApbStaticReadObjectsResp", {
+            "objects": {
+                "success": True,
+                "objects": [
+                    value_to_read_resp(t, v)
+                    for (_, t, _), v in zip(objs, vals)
+                ],
+            },
+            "committime": {"success": True, "commit_time": _enc_clock(vc)},
+        }
+    except Exception as e:
+        return "ApbErrorResp", {
+            "errmsg": to_bytes(f"{type(e).__name__}: {e}"), "errcode": 0,
+        }
 
 
 def _dispatch(server, name: str, req: Dict[str, Any],
@@ -491,29 +529,6 @@ def _dispatch(server, name: str, req: Dict[str, Any],
             if txn is not None:
                 node.abort_transaction(txn)
             return "ApbOperationResp", {"success": True}
-        if name == "ApbStaticUpdateObjects":
-            clock = _dec_clock(req["transaction"].get("timestamp"))
-            vc = node.update_objects(
-                updates_from_update_ops(req.get("updates", []), my_dc),
-                clock=clock,
-            )
-            return "ApbCommitResp", {
-                "success": True, "commit_time": _enc_clock(vc),
-            }
-        if name == "ApbStaticReadObjects":
-            clock = _dec_clock(req["transaction"].get("timestamp"))
-            objs = [_bound_object(bo) for bo in req.get("objects", [])]
-            vals, vc = node.read_objects(objs, clock=clock)
-            return "ApbStaticReadObjectsResp", {
-                "objects": {
-                    "success": True,
-                    "objects": [
-                        value_to_read_resp(t, v)
-                        for (_, t, _), v in zip(objs, vals)
-                    ],
-                },
-                "committime": {"success": True, "commit_time": _enc_clock(vc)},
-            }
         return "ApbErrorResp", {
             "errmsg": to_bytes(f"unhandled apb request {name}"), "errcode": 0,
         }

@@ -16,9 +16,10 @@ matching the single-writer-per-partition design (SURVEY §2.10 row 2).
 from __future__ import annotations
 
 import logging
+import queue
 import socketserver
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -38,6 +39,24 @@ from antidote_tpu.txn.manager import AbortError, Transaction
 DEFAULT_PORT = 8087
 log = logging.getLogger(__name__)
 
+_STOP = object()
+
+
+class _StaticWork:
+    """One client's static read/update parked at the batch gate."""
+
+    __slots__ = ("kind", "objects", "updates", "clock", "event", "result",
+                 "error")
+
+    def __init__(self, kind, objects=None, updates=None, clock=None):
+        self.kind = kind
+        self.objects = objects
+        self.updates = updates
+        self.clock = clock
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
 
 def _decode_objects(objs):
     return [(freeze(k), t, b) for k, t, b in (freeze(o) for o in objs)]
@@ -54,12 +73,29 @@ def _vc(x) -> Optional[np.ndarray]:
 
 class ProtocolServer:
     def __init__(self, node: AntidoteNode, host: str = "127.0.0.1",
-                 port: int = 0, interdc=None, max_connections: int = 1024):
+                 port: int = 0, interdc=None, max_connections: int = 1024,
+                 batch_static: bool = True):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
         self._lock = threading.Lock()
         self._txns: Dict[int, Transaction] = {}
+        #: cross-connection batch gate (r4 VERDICT item 3): static
+        #: reads/updates from concurrent connections coalesce into single
+        #: device launches instead of one launch per socket — the wire
+        #: analogue of SURVEY §2.10 "batch thousands of reads per launch"
+        #: (the reference scales the same path with 20 read servers per
+        #: partition, /root/reference/include/antidote.hrl:28)
+        self.batch_static = batch_static
+        self._closing = False
+        self._static_q: "queue.Queue" = queue.Queue()
+        self._batch_max = 1024
+        if batch_static:
+            self._batcher = threading.Thread(
+                target=self._static_loop, daemon=True,
+                name="antidote-proto-batch",
+            )
+            self._batcher.start()
         #: connection cap (the reference's ranch listener caps at 1024,
         #: /root/reference/src/antidote_pb_sup.erl:47-56).  The accept
         #: loop blocks on the semaphore when the cap is reached, so
@@ -182,7 +218,179 @@ class ProtocolServer:
                 self.node.abort_transaction(txn)
 
     # ------------------------------------------------------------------
+    # static batch gate
+    # ------------------------------------------------------------------
+    def static_read(self, objects, clock):
+        """Batched static read: (values, snapshot_vc)."""
+        if not self.batch_static:
+            with self._lock:
+                return self.node.read_objects(objects, clock=_vc(clock))
+        return self._submit(_StaticWork("read", objects=objects,
+                                        clock=_vc(clock)))
+
+    def static_update(self, updates, clock):
+        """Batched static update: commit VC (raises AbortError on cert)."""
+        if not self.batch_static:
+            with self._lock:
+                return self.node.update_objects(updates, clock=_vc(clock))
+        return self._submit(_StaticWork("update", updates=updates,
+                                        clock=_vc(clock)))
+
+    def _submit(self, work: _StaticWork):
+        if self._closing:
+            raise ConnectionError("server shutting down")
+        self._static_q.put(work)
+        if not work.event.wait(timeout=300):
+            raise TimeoutError("static batch dispatcher stalled")
+        if work.error is not None:
+            raise work.error
+        return work.result
+
+    def _static_loop(self):
+        """The batch dispatcher: drain whatever has queued while the
+        previous group executed, run updates as ONE group commit and reads
+        as ONE merged snapshot read.  Natural batching — no gather delay:
+        at low load a lone request runs immediately; under load the batch
+        grows to whatever queued during the previous launch."""
+        q = self._static_q
+        while True:
+            first = q.get()
+            batch = [first]
+            while len(batch) < self._batch_max:
+                try:
+                    batch.append(q.get_nowait())
+                except queue.Empty:
+                    break
+            stop = any(w is _STOP for w in batch)
+            works: List[_StaticWork] = [w for w in batch if w is not _STOP]
+            try:
+                ups = [w for w in works if w.kind == "update"]
+                reads = [w for w in works if w.kind == "read"]
+                with self._lock:
+                    # updates first: the merged read then serves at a
+                    # snapshot covering them (fresh-path + cache friendly)
+                    if ups:
+                        self._run_update_group(ups)
+                    if reads:
+                        self._run_read_group(reads)
+            except BaseException as e:  # never strand a parked connection
+                for w in works:
+                    if not w.event.is_set():
+                        w.error = e
+                        w.event.set()
+            if stop:
+                # fail anything that raced the shutdown into the queue —
+                # a handler parked behind the sentinel must not wait out
+                # its submit timeout
+                while True:
+                    try:
+                        w = q.get_nowait()
+                    except queue.Empty:
+                        return
+                    if w is not _STOP:
+                        w.error = ConnectionError("server shutting down")
+                        w.event.set()
+
+    def _run_read_group(self, works: List[_StaticWork]) -> None:
+        clock = None
+        for w in works:
+            if w.clock is not None:
+                clock = w.clock if clock is None else np.maximum(clock, w.clock)
+        objs: list = []
+        offs = [0]
+        for w in works:
+            objs.extend(w.objects)
+            offs.append(len(objs))
+        try:
+            vals, vc = self.node.read_objects(objs, clock=clock)
+            for i, w in enumerate(works):
+                w.result = (vals[offs[i]:offs[i + 1]], vc)
+                w.event.set()
+        except Exception:
+            # isolate the offending request: replay each alone
+            for w in works:
+                try:
+                    w.result = self.node.read_objects(w.objects, clock=w.clock)
+                except Exception as e:
+                    w.error = e
+                w.event.set()
+
+    def _run_update_group(self, works: List[_StaticWork]) -> None:
+        txm = getattr(self.node, "txm", None)
+        if txm is None or len(works) == 1:
+            # cluster coordinator (2PC) or a lone update: sequential path
+            for w in works:
+                try:
+                    w.result = self.node.update_objects(w.updates,
+                                                        clock=w.clock)
+                except Exception as e:
+                    w.error = e
+                w.event.set()
+            return
+        pending = list(works)
+        # Group members share a snapshot, so two blind writes to one hot
+        # key first-committer-abort each other — a conflict the pre-batch
+        # serial path could never produce (each request's snapshot
+        # followed the previous commit).  Losers retry as a FOLLOW-UP
+        # GROUP at a fresh snapshot (≥1 winner per round → ≤N rounds,
+        # still one device append per round) — equivalent to some serial
+        # interleaving, so no spurious abort escapes to a client.
+        while pending:
+            staged = []
+            for w in pending:
+                try:
+                    txn = txm.start_transaction(w.clock)
+                    try:
+                        txm.update_objects(w.updates, txn)
+                    except Exception:
+                        txm.abort_transaction(txn)
+                        raise
+                    staged.append((w, txn))
+                except Exception as e:
+                    w.error = e
+                    w.event.set()
+            if not staged:
+                return
+            try:
+                outs = txm.commit_transactions_group([t for _, t in staged])
+            except Exception as e:
+                for w, _ in staged:
+                    w.error = e
+                    w.event.set()
+                return
+            retry = []
+            for (w, _), r in zip(staged, outs):
+                if isinstance(r, AbortError):
+                    retry.append(w)
+                elif isinstance(r, Exception):
+                    w.error = r
+                    w.event.set()
+                else:
+                    w.result = r
+                    w.event.set()
+            pending = retry
+
+    # ------------------------------------------------------------------
     def _process(self, code: MessageCode, body: Any):
+        # static ops route through the gate helpers OUTSIDE the lock (the
+        # gate's dispatcher takes it; with batching off they lock inline)
+        # — the ONLY static dispatch path, so it cannot drift from a
+        # duplicate
+        if code == MessageCode.STATIC_READ_OBJECTS:
+            vals, vc = self.static_read(
+                _decode_objects(body["objects"]), body.get("clock")
+            )
+            return MessageCode.READ_OBJECTS_RESP, {
+                "values": [encode_value(v) for v in vals],
+                "commit_clock": [int(x) for x in vc],
+            }
+        if code == MessageCode.STATIC_UPDATE_OBJECTS:
+            vc = self.static_update(
+                _decode_updates(body["updates"]), body.get("clock")
+            )
+            return MessageCode.COMMIT_RESP, {
+                "commit_clock": [int(x) for x in vc]
+            }
         with self._lock:
             return self._dispatch(code, body)
 
@@ -218,21 +426,6 @@ class ProtocolServer:
             txn = self._txns.pop(body["txid"])
             node.abort_transaction(txn)
             return MessageCode.OPERATION_RESP, {"ok": True}
-        if code == MessageCode.STATIC_UPDATE_OBJECTS:
-            vc = node.update_objects(
-                _decode_updates(body["updates"]), clock=_vc(body.get("clock"))
-            )
-            return MessageCode.COMMIT_RESP, {
-                "commit_clock": [int(x) for x in vc]
-            }
-        if code == MessageCode.STATIC_READ_OBJECTS:
-            vals, vc = node.read_objects(
-                _decode_objects(body["objects"]), clock=_vc(body.get("clock"))
-            )
-            return MessageCode.READ_OBJECTS_RESP, {
-                "values": [encode_value(v) for v in vals],
-                "commit_clock": [int(x) for x in vc],
-            }
         if code == MessageCode.GET_CONNECTION_DESCRIPTOR:
             if self.interdc is None:
                 raise RuntimeError("no inter-DC replica attached")
@@ -262,6 +455,10 @@ class ProtocolServer:
         return self._thread.is_alive()
 
     def close(self) -> None:
+        self._closing = True
         self._server.shutdown()
         self._server.server_close()
+        if self.batch_static:
+            self._static_q.put(_STOP)
+            self._batcher.join(timeout=5)
         self._thread.join(timeout=5)

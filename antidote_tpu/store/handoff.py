@@ -155,6 +155,7 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
         t.ops_vc = t.ops_vc.at[dst, base:end].set(sl["ops_vc"])
         t.ops_origin = t.ops_origin.at[dst, base:end].set(sl["ops_origin"])
         t.head_vc = t.head_vc.at[dst, base:end].set(sl["head_vc"])
+        t.invalidate_epochs()  # out-of-band mutation: frozen copies stale
         t.n_ops[dst, base:end] = sl["n_ops"]
         # packages from builds predating the overflow hatch lack the slot
         # bound; the conservative default (capacity) forces a promotion on
@@ -216,6 +217,7 @@ def drop_shard(store: KVStore, shard: int) -> None:
             t.ops_vc = t.ops_vc.at[shard].set(0)
             t.ops_origin = t.ops_origin.at[shard].set(0)
             t.head_vc = t.head_vc.at[shard].set(0)
+            t.invalidate_epochs()
             t.n_ops[shard] = 0
             t.slots_ub[shard] = 0
         t.used_rows[shard] = 0

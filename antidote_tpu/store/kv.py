@@ -136,6 +136,54 @@ class Effect:
         self.blob_refs = list(blob_refs)
 
 
+def _make_promote_fn():
+    """One-launch tier promotion: move a key's whole device state (head,
+    snapshot versions, op ring) from its current table into a wider-slot
+    sibling, zero-padding the widened slot/lane axes (zeros are empty
+    slots in every slotted layout) and clearing the source row.  Version
+    seqs renumber above everything in the destination so the per-key
+    newest-version order survives the move.  Jitted per (src, dst) tier
+    pair — the previous eager form was ~25 separate device dispatches,
+    a visible serving-latency spike per hot-key tier crossing."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def fn(src, dst, shard, row, new_row, seq_shift):
+        def emb(v, dshape):
+            out = jnp.zeros(dshape, v.dtype)
+            return out.at[tuple(slice(0, s) for s in v.shape)].set(v)
+
+        out_d = {"snap": {}, "head": {}}
+        out_s = {"snap": {}, "head": {}}
+        for grp in ("snap", "head"):
+            for f in src[grp]:
+                v = src[grp][f][shard, row]
+                out_d[grp][f] = dst[grp][f].at[shard, new_row].set(
+                    emb(v, dst[grp][f].shape[2:])
+                )
+                out_s[grp][f] = src[grp][f].at[shard, row].set(0)
+        seq = src["snap_seq"][shard, row]
+        seq = jnp.where(seq > 0, seq + seq_shift, 0)
+        out_d["snap_seq"] = dst["snap_seq"].at[shard, new_row].set(seq)
+        for name in ("snap_vc", "ops_vc", "ops_origin", "head_vc"):
+            out_d[name] = dst[name].at[shard, new_row].set(
+                src[name][shard, row]
+            )
+        for name in ("ops_a", "ops_b"):
+            out_d[name] = dst[name].at[shard, new_row].set(
+                emb(src[name][shard, row], dst[name].shape[2:])
+            )
+        for name in ("snap_vc", "snap_seq", "ops_a", "ops_b", "ops_vc",
+                     "ops_origin", "head_vc"):
+            out_s[name] = src[name].at[shard, row].set(0)
+        return out_s, out_d
+
+    return fn
+
+
 #: distinct miss marker (None is a legitimate cached value)
 _CACHE_MISS = object()
 
@@ -188,10 +236,22 @@ class KVStore:
 
         self._value_cache: "_OD[Tuple[Any, str], tuple]" = _OD()
         self._value_cache_cap = 65536
+        #: guards every _value_cache access: the ProtocolServer happens
+        #: to serialize txm calls today, but an embedder driving reads
+        #: from one thread while inter-DC ingress applies effects from
+        #: another would race get/move_to_end against pop (r4 advisor)
+        import threading as _threading
+
+        self._value_cache_lock = _threading.Lock()
         #: bumped once per apply_effects batch; fills racing a concurrent
         #: commit are dropped (the entry could otherwise claim a fill
         #: clock that already covers the commit it never saw)
         self.mutation_epoch = 0
+        #: (src_tname, dst_tname) -> jitted one-launch row promotion —
+        #: ~25 eager device ops per promotion otherwise, each a dispatch
+        #: (and on first use a compile), which made every hot-key tier
+        #: crossing a serving latency spike
+        self._promote_fns: Dict[Tuple[str, str], Any] = {}
 
     def _is_slotted(self, type_name: str) -> bool:
         hit = self._slotted.get(type_name)
@@ -309,15 +369,16 @@ class KVStore:
             self._promote_key(dk, extra_demand=d, min_tier=need_t)
         by_table: Dict[str, list] = {}
         touched = []
+        inval: List[Tuple[Any, str]] = []
         for i, eff in enumerate(effects):
             tname_t, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
-            self._value_cache.pop((eff.key, eff.bucket), None)
+            inval.append((eff.key, eff.bucket))
             # composite invalidation: a field/membership write kills the
             # parent map's assembled value (recursively for nested maps)
             k = eff.key
             while type(k) is tuple and len(k) >= 2 and k[0] in _DERIVED_NS:
                 k = k[1]
-                self._value_cache.pop((k, eff.bucket), None)
+                inval.append((k, eff.bucket))
             for h, data in eff.blob_refs:
                 self.blobs.intern_bytes(h, data)
             if self.log is not None:
@@ -331,6 +392,11 @@ class KVStore:
                 (shard, row, eff.eff_a, eff.eff_b, commit_vcs[i], origins[i])
             )
             touched.append((shard, np.asarray(commit_vcs[i], np.int32)))
+        if inval:
+            # one locked sweep per batch, not one acquisition per effect
+            with self._value_cache_lock:
+                for dk in inval:
+                    self._value_cache.pop(dk, None)
         if self.log is not None and touched:
             self.log.commit_barrier([s for s, _ in touched])
         for tname_t, items in by_table.items():
@@ -359,13 +425,14 @@ class KVStore:
         """Cached decoded value, or None-marker miss.  Valid iff the read
         VC dominates the fill clock (then the unchanged key's latest
         state IS the cached one)."""
-        ent = self._value_cache.get((key, bucket))
-        if ent is None:
-            return _CACHE_MISS
-        value, fill_vc = ent
-        if all(r >= f for r, f in zip(read_vc_tuple, fill_vc)):
-            self._value_cache.move_to_end((key, bucket))
-            return _copy_out(value)
+        with self._value_cache_lock:
+            ent = self._value_cache.get((key, bucket))
+            if ent is None:
+                return _CACHE_MISS
+            value, fill_vc = ent
+            if all(r >= f for r, f in zip(read_vc_tuple, fill_vc)):
+                self._value_cache.move_to_end((key, bucket))
+                return _copy_out(value)
         return _CACHE_MISS
 
     def value_cache_bulk_get(self, objects, read_vc_tuple):
@@ -379,13 +446,14 @@ class KVStore:
         miss: List[int] = []
         if all(r >= f for r, f in zip(read_vc_tuple,
                                       self.applied_vc.max(axis=0))):
-            for j, (key, _t, bucket) in enumerate(objects):
-                ent = cache.get((key, bucket))
-                if ent is None:
-                    miss.append(j)
-                else:
-                    cache.move_to_end((key, bucket))
-                    out[j] = _copy_out(ent[0])
+            with self._value_cache_lock:
+                for j, (key, _t, bucket) in enumerate(objects):
+                    ent = cache.get((key, bucket))
+                    if ent is None:
+                        miss.append(j)
+                    else:
+                        cache.move_to_end((key, bucket))
+                        out[j] = _copy_out(ent[0])
             return out, miss
         for j, (key, _t, bucket) in enumerate(objects):
             hit = self.value_cache_get(key, bucket, read_vc_tuple)
@@ -406,9 +474,12 @@ class KVStore:
             return
         # own a copy: the caller's value is handed to the client, who may
         # mutate it
-        self._value_cache[(key, bucket)] = (_copy_out(value), fill_vc_tuple)
-        while len(self._value_cache) > self._value_cache_cap:
-            self._value_cache.popitem(last=False)
+        with self._value_cache_lock:
+            self._value_cache[(key, bucket)] = (
+                _copy_out(value), fill_vc_tuple
+            )
+            while len(self._value_cache) > self._value_cache_cap:
+                self._value_cache.popitem(last=False)
 
     def applied_max_tuple(self) -> tuple:
         return tuple(int(x) for x in self.applied_vc.max(axis=0))
@@ -466,64 +537,48 @@ class KVStore:
             new_tier += 1
         t_new = self.table(tiered_name(base, new_tier))
         new_row = t_new.alloc_row(shard)
-
-        def embed(src: np.ndarray, dst_shape) -> np.ndarray:
-            out = np.zeros(dst_shape, src.dtype)
-            out[tuple(slice(0, s) for s in src.shape)] = src
-            return out
-
-        for f in t_old.snap:
-            src = np.asarray(t_old.snap[f][shard, row])
-            t_new.snap[f] = t_new.snap[f].at[shard, new_row].set(
-                embed(src, t_new.snap[f].shape[2:])
-            )
-            hsrc = head_state[f]
-            t_new.head[f] = t_new.head[f].at[shard, new_row].set(
-                embed(hsrc, t_new.head[f].shape[2:])
-            )
-        t_new.snap_vc = t_new.snap_vc.at[shard, new_row].set(
-            np.asarray(t_old.snap_vc[shard, row])
+        src_name, dst_name = tname_t, tiered_name(base, new_tier)
+        fn = self._promote_fns.get((src_name, dst_name))
+        if fn is None:
+            fn = _make_promote_fn()
+            self._promote_fns[(src_name, dst_name)] = fn
+        src_tree = {
+            "snap": t_old.snap, "head": t_old.head,
+            "snap_vc": t_old.snap_vc, "snap_seq": t_old.snap_seq,
+            "ops_a": t_old.ops_a, "ops_b": t_old.ops_b,
+            "ops_vc": t_old.ops_vc, "ops_origin": t_old.ops_origin,
+            "head_vc": t_old.head_vc,
+        }
+        dst_tree = {
+            "snap": t_new.snap, "head": t_new.head,
+            "snap_vc": t_new.snap_vc, "snap_seq": t_new.snap_seq,
+            "ops_a": t_new.ops_a, "ops_b": t_new.ops_b,
+            "ops_vc": t_new.ops_vc, "ops_origin": t_new.ops_origin,
+            "head_vc": t_new.head_vc,
+        }
+        src_tree, dst_tree = fn(
+            src_tree, dst_tree,
+            np.int64(shard), np.int64(row), np.int64(new_row),
+            np.int64(t_new.next_seq),
         )
-        # renumber version seqs above everything in the new table so the
-        # per-key newest-version order survives the move
-        seq = np.asarray(t_old.snap_seq[shard, row], np.int64)
-        seq = np.where(seq > 0, seq + t_new.next_seq, 0)
         t_new.next_seq += int(t_old.next_seq)
-        t_new.snap_seq = t_new.snap_seq.at[shard, new_row].set(seq)
-        t_new.ops_a = t_new.ops_a.at[shard, new_row].set(
-            embed(np.asarray(t_old.ops_a[shard, row]), t_new.ops_a.shape[2:])
-        )
-        t_new.ops_b = t_new.ops_b.at[shard, new_row].set(
-            embed(np.asarray(t_old.ops_b[shard, row]), t_new.ops_b.shape[2:])
-        )
-        t_new.ops_vc = t_new.ops_vc.at[shard, new_row].set(
-            np.asarray(t_old.ops_vc[shard, row])
-        )
-        t_new.ops_origin = t_new.ops_origin.at[shard, new_row].set(
-            np.asarray(t_old.ops_origin[shard, row])
-        )
-        t_new.head_vc = t_new.head_vc.at[shard, new_row].set(
-            np.asarray(t_old.head_vc[shard, row])
-        )
+        for t, tree in ((t_old, src_tree), (t_new, dst_tree)):
+            t.snap, t.head = tree["snap"], tree["head"]
+            t.snap_vc, t.snap_seq = tree["snap_vc"], tree["snap_seq"]
+            t.ops_a, t.ops_b = tree["ops_a"], tree["ops_b"]
+            t.ops_vc, t.ops_origin = tree["ops_vc"], tree["ops_origin"]
+            t.head_vc = tree["head_vc"]
         t_new.n_ops[shard, new_row] = t_old.n_ops[shard, row]
         t_new.slots_ub[shard, new_row] = used + extra_demand
         t_new.max_abs_delta = max(t_new.max_abs_delta, t_old.max_abs_delta)
         np.maximum(t_new.max_commit_vc, t_old.max_commit_vc,
                    out=t_new.max_commit_vc)
-        # clear the old row: it stays allocated (orphaned — promotions are
-        # rare) but must never serve stale state
-        for f in t_old.snap:
-            t_old.snap[f] = t_old.snap[f].at[shard, row].set(0)
-            t_old.head[f] = t_old.head[f].at[shard, row].set(0)
-        t_old.snap_vc = t_old.snap_vc.at[shard, row].set(0)
-        t_old.snap_seq = t_old.snap_seq.at[shard, row].set(0)
-        t_old.ops_a = t_old.ops_a.at[shard, row].set(0)
-        t_old.ops_b = t_old.ops_b.at[shard, row].set(0)
-        t_old.ops_vc = t_old.ops_vc.at[shard, row].set(0)
-        t_old.ops_origin = t_old.ops_origin.at[shard, row].set(0)
-        t_old.head_vc = t_old.head_vc.at[shard, row].set(0)
         t_old.n_ops[shard, row] = 0
         t_old.slots_ub[shard, row] = 0
+        # both tables mutated outside the append path: frozen epoch copies
+        # would serve the pre-promotion (old table) / bottom (new table) row
+        t_old.invalidate_epochs()
+        t_new.invalidate_epochs()
         self.directory[dk] = (tiered_name(base, new_tier), shard, new_row)
         self.promotions += 1
 

@@ -249,6 +249,15 @@ class TypedTable:
             f: mk((p, n) + shape, dtype) for f, (shape, dtype) in spec.items()
         }
         self.head_vc = mk((p, n, d), jnp.int32)
+        # published serving epochs: frozen copies of (head, head_vc) plus
+        # the max-commit-VC cap at publish time — the read-while-write
+        # double buffer (r4 VERDICT item 2).  Reads pinned at a VC ≤ cap
+        # serve from the frozen copy as pure gathers while the live head
+        # absorbs writes; see :meth:`publish_epoch` for the correctness
+        # contract.  LRU-retained (an epoch a pinned snapshot still reads
+        # stays alive; at most ``_EPOCH_CAP`` kept).
+        self.epochs: list = []
+        self._epoch_uses = 0
 
     # ------------------------------------------------------------------
     # row allocation / growth
@@ -282,6 +291,64 @@ class TypedTable:
         self.n_ops = np.pad(self.n_ops, ((0, 0), (0, new_n - self.n_rows)))
         self.slots_ub = np.pad(self.slots_ub, ((0, 0), (0, new_n - self.n_rows)))
         self.n_rows = new_n
+        # epoch copies still have the old row extent — row indices past it
+        # would gather-clip onto the wrong key
+        self.invalidate_epochs()
+
+    # ------------------------------------------------------------------
+    # serving epochs (read-while-write double buffer)
+    # ------------------------------------------------------------------
+    _EPOCH_CAP = 2
+
+    @functools.cached_property
+    def _copy_tree_fn(self):
+        return jax.jit(lambda tree: jax.tree.map(jnp.copy, tree))
+
+    def publish_epoch(self) -> None:
+        """Freeze the current head as a serving epoch.
+
+        Correctness contract (the reason an epoch gather is an *exact*
+        snapshot read): ``cap`` is the entry-wise max commit VC this table
+        has absorbed at publish time.  Appends are causally gated — an op
+        from origin ``o`` carries a commit timestamp on lane ``o`` strictly
+        above every lane-``o`` value previously appended (local sequencer
+        monotonicity; remote chains apply in op-id order behind the causal
+        gate, so a cross-origin snapshot entry can never outrun its
+        origin's applied ops).  Hence any op appended AFTER publish is
+        invisible at any read VC ``R ≤ cap``, and a row whose frozen
+        ``head_vc ≤ R`` serves exactly — the double-buffered analogue of
+        the reference's lock-free reads against a single writer
+        (/root/reference/src/materializer_vnode.erl:93-102)."""
+        frozen = self._copy_tree_fn((self.head, self.head_vc))
+        self._epoch_uses += 1
+        self.epochs.append({
+            "head": frozen[0],
+            "head_vc": frozen[1],
+            "cap": self.max_commit_vc.copy(),
+            "seq": self._epoch_uses,   # publish order (age)
+            "used": self._epoch_uses,  # recency (eviction only)
+        })
+        if len(self.epochs) > self._EPOCH_CAP:
+            victim = min(self.epochs, key=lambda e: e["used"])
+            self.epochs = [e for e in self.epochs if e is not victim]
+
+    def invalidate_epochs(self) -> None:
+        """Drop every published epoch — required after any out-of-band
+        table mutation (row growth, key promotion, handoff install)."""
+        self.epochs.clear()
+
+    def _epoch_for(self, read_vcs: np.ndarray):
+        """Oldest epoch whose cap dominates every read VC in the batch
+        (oldest = closest above the pin = most rows frozen-fresh)."""
+        best = None
+        for e in self.epochs:
+            if (read_vcs <= e["cap"]).all():
+                if best is None or e["seq"] < best["seq"]:
+                    best = e
+        if best is not None:
+            self._epoch_uses += 1
+            best["used"] = self._epoch_uses
+        return best
 
     # ------------------------------------------------------------------
     # device kernels
@@ -387,15 +454,19 @@ class TypedTable:
 
         return fn
 
-    def _read_resolved_fn(self, pallas_counter: bool):
+    def _read_resolved_fn(self, pallas_counter: bool, kmax: int = 0):
         """The fused serving read: head gather + snapshot-version select +
         versioned ring fold + freshness select + device value resolution,
         all in ONE launch — the whole read path of SURVEY §3.3
         (check-freshness ≈ check_clock, fold ≈ clocksi_materializer:
         materialize, resolution ≈ Type:value) without intermediate host
         round trips.  ``pallas_counter`` dispatches the counter-family fold
-        to the fused Pallas masked-sum kernel (VERDICT r1 item 3)."""
-        cached = self._resolved_fns.get(pallas_counter)
+        to the fused Pallas masked-sum kernel (VERDICT r1 item 3).
+        ``kmax`` > 0 folds only ring slots [0, kmax) — valid whenever the
+        host-tracked ``n_ops`` max over the batch is ≤ kmax (rings fill
+        from 0 and reset at GC), cutting fold work from ops_per_key to the
+        actual used prefix (r4 VERDICT item 4)."""
+        cached = self._resolved_fns.get((pallas_counter, kmax))
         if cached is not None:
             return cached
         ty, cfg = self.ty, self.cfg
@@ -409,7 +480,12 @@ class TypedTable:
             base_state, base_vc, complete = jax.vmap(select)(
                 snap, snap_vc, snap_seq, rows, read_vcs
             )
-            gat = jax.vmap(lambda x, r: x[r])
+            if kmax:
+                # slice the fold to the used ring prefix AFTER the row
+                # gather (fuses; never materializes a sliced table copy)
+                gat = jax.vmap(lambda x, r: x[r, :kmax])
+            else:
+                gat = jax.vmap(lambda x, r: x[r])
             opa, opv = gat(ops_a, rows), gat(ops_vc, rows)
             if pallas_counter:
                 from antidote_tpu.materializer import pallas_kernels as pk
@@ -451,7 +527,7 @@ class TypedTable:
             )
             return resolved, fresh, complete
 
-        self._resolved_fns[pallas_counter] = fn
+        self._resolved_fns[(pallas_counter, kmax)] = fn
         return fn
 
     @functools.cached_property
@@ -477,12 +553,13 @@ class TypedTable:
 
         return fn
 
-    def _read_resolved_flat_fn(self, pallas_counter: bool):
+    def _read_resolved_flat_fn(self, pallas_counter: bool, kmax: int = 0):
         """Flat single-gather variant of :meth:`_read_resolved_fn`: the
         same fused serving read (freshness + version select + ring fold +
         resolution, one launch) with the batch as the leading axis — the
-        per-shard bodies run on pre-gathered rows via an identity index."""
-        cached = self._resolved_flat_fns.get(pallas_counter)
+        per-shard bodies run on pre-gathered rows via an identity index.
+        ``kmax`` as in :meth:`_read_resolved_fn`."""
+        cached = self._resolved_flat_fns.get((pallas_counter, kmax))
         if cached is not None:
             return cached
         ty, cfg = self.ty, self.cfg
@@ -501,7 +578,11 @@ class TypedTable:
                 {f: x[ss, rr] for f, x in snap.items()},
                 snap_vc[ss, rr], snap_seq[ss, rr], idx, read_vcs,
             )
-            opa, opv = ops_a[ss, rr], ops_vc[ss, rr]
+            if kmax:
+                opa = ops_a[ss, rr][:, :kmax]
+                opv = ops_vc[ss, rr][:, :kmax]
+            else:
+                opa, opv = ops_a[ss, rr], ops_vc[ss, rr]
             if pallas_counter:
                 from antidote_tpu.materializer import pallas_kernels as pk
 
@@ -513,9 +594,12 @@ class TypedTable:
                 )
                 state_f = {"cnt": base_state["cnt"] + dcnt.astype(jnp.int64)}
             else:
+                opb, opo = ops_b[ss, rr], ops_origin[ss, rr]
+                if kmax:
+                    opb, opo = opb[:, :kmax], opo[:, :kmax]
                 state_f, applied = fold_mod.fold_batch(
-                    ty, cfg, base_state, opa, ops_b[ss, rr], opv,
-                    ops_origin[ss, rr], n_ops_flat, base_vc, read_vcs,
+                    ty, cfg, base_state, opa, opb, opv,
+                    opo, n_ops_flat, base_vc, read_vcs,
                 )
             state = {
                 f: jnp.where(
@@ -532,15 +616,51 @@ class TypedTable:
             )
             return resolved, fresh, complete
 
-        self._resolved_flat_fns[pallas_counter] = fn
+        self._resolved_flat_fns[(pallas_counter, kmax)] = fn
         return fn
 
+    @functools.cached_property
+    def _merge_scatter_fn(self):
+        @jax.jit
+        def fn(dst_tree, idx, src_tree):
+            return jax.tree.map(
+                lambda d, s: d.at[idx].set(s, mode="drop"), dst_tree, src_tree
+            )
+
+        return fn
+
+    def _kmax_bucket(self, n: int) -> int:
+        """Power-of-4 fold-window bucket covering ``n`` used ring slots
+        (0 = fold the whole ring).  Coarse on purpose: every distinct
+        kmax is a separate XLA compile of the whole serve path, and on a
+        small host a compile is a multi-second serving outage — fewer,
+        slightly-wider folds beat a tight ladder."""
+        w = 4
+        while w < n:
+            w *= 4
+        return 0 if w >= self.cfg.ops_per_key else w
+
     def read_resolved_flat(self, shards, rows, read_vcs):
-        """One-launch flat serving read — no host routing, no unroute:
-        returns DEVICE arrays (resolved fields [M, ...], fresh [M],
-        complete [M]) in input order.  The single-device fast path;
-        callers on a mesh use :meth:`read_resolved_raw` (routed layout
-        keeps the gather shard-local)."""
+        """Flat serving read — no host routing, no unroute: returns
+        (resolved fields [M, ...], fresh [M], complete [M]) in input
+        order (device arrays on the all-gather paths, the fold path
+        merges on device but returns host fresh/complete).  The
+        single-device fast path; callers on a mesh use
+        :meth:`read_resolved_raw` (routed layout keeps gathers
+        shard-local).
+
+        Dispatch ladder (r4 VERDICT item 2 — reads must not collapse
+        under a concurrent write stream):
+
+        1. read VC dominates every commit seen → live head gather.
+        2. read VC pinned exactly at a published epoch's cap → frozen
+           head gather (the double-buffer hot path: writers advance the
+           live head, pinned readers never see them).
+        3. otherwise two-phase: gather (frozen epoch if one covers the
+           VC, else live head), host-check freshness, and run the
+           versioned ring fold ONLY on the stale remainder — fold work
+           scales with the write working set, not the read batch.
+        """
         shards = np.asarray(shards, np.int64)
         rows = np.asarray(rows, np.int64)
         read_vcs = np.asarray(read_vcs, np.int32)
@@ -549,13 +669,49 @@ class TypedTable:
                 self.head, self.head_vc, shards, rows, read_vcs
             )
             return resolved, fresh, fresh
-        n_ops_flat = self.n_ops[shards, rows]
-        fn = self._read_resolved_flat_fn(self._pallas_counter_ok())
-        return fn(
+        epoch = self._epoch_for(read_vcs)
+        if epoch is not None and (read_vcs >= epoch["cap"]).all():
+            # pinned exactly at the epoch cap: every row frozen-fresh
+            # (head_vc ≤ cap = R row-wise) — pure gather, no host sync
+            resolved, fresh = self._latest_resolved_flat_fn(
+                epoch["head"], epoch["head_vc"], shards, rows, read_vcs
+            )
+            return resolved, fresh, fresh
+        if epoch is not None:
+            src_head, src_vc = epoch["head"], epoch["head_vc"]
+        else:
+            src_head, src_vc = self.head, self.head_vc
+        resolved_h, fresh_d = self._latest_resolved_flat_fn(
+            src_head, src_vc, shards, rows, read_vcs
+        )
+        fresh = np.asarray(fresh_d)
+        if fresh.all():
+            return resolved_h, fresh, fresh
+        stale = np.nonzero(~fresh)[0]
+        ns = len(stale)
+        mb = _bucket(ns, self.cfg.batch_buckets)
+        pad = mb - ns
+        sss = np.concatenate([shards[stale], np.zeros(pad, np.int64)])
+        rrs = np.concatenate([rows[stale], np.zeros(pad, np.int64)])
+        vcss = np.concatenate(
+            [read_vcs[stale], np.zeros((pad, read_vcs.shape[-1]), np.int32)]
+        )
+        n_ops_flat = self.n_ops[sss, rrs]
+        n_ops_flat[ns:] = 0
+        kmax = self._kmax_bucket(int(n_ops_flat.max()))
+        fn = self._read_resolved_flat_fn(self._pallas_counter_ok(), kmax)
+        resolved_s, _, complete_s = fn(
             self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
-            shards, rows, n_ops_flat, read_vcs,
+            sss, rrs, n_ops_flat, vcss,
         )
+        # scatter the folded rows back over the gathered batch on device
+        # (padding scatters at index M → dropped)
+        midx = np.concatenate([stale, np.full(pad, len(shards), np.int64)])
+        merged = self._merge_scatter_fn(resolved_h, midx, resolved_s)
+        complete = fresh.copy()
+        complete[stale] = np.asarray(complete_s)[:ns]
+        return merged, fresh, complete
 
     # ------------------------------------------------------------------
     # host routing helpers
@@ -669,10 +825,11 @@ class TypedTable:
         end_mat = np.zeros(row_mat.shape, np.int64)
         start_mat[pos[:, 0], pos[:, 1]] = starts
         end_mat[pos[:, 0], pos[:, 1]] = ends
+        # window choice is deliberately binary (1-op commits vs full-ring
+        # scan): each window is a separate XLA compile of the head fold,
+        # and compile outages cost more than the extra masked slots
         span = int(ucount.max()) if len(ucount) else 0
-        w = 1
-        while w < span:
-            w *= 2
+        w = 1 if span <= 1 else k
         self.head, self.head_vc = self._head_update_for(0 if w >= k else w)(
             self.head, self.head_vc,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
@@ -751,7 +908,8 @@ class TypedTable:
             return resolved, fresh, fresh, pos
         n_ops_mat = self.n_ops[np.arange(p)[:, None], row_gather]
         n_ops_mat = np.where(row_mat < self.n_rows, n_ops_mat, 0)
-        fn = self._read_resolved_fn(self._pallas_counter_ok())
+        kmax = self._kmax_bucket(int(n_ops_mat.max()) if n_ops_mat.size else 1)
+        fn = self._read_resolved_fn(self._pallas_counter_ok(), kmax)
         resolved, fresh, complete = fn(
             self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,

@@ -200,7 +200,9 @@ def child(args):
     if want and "," not in want:
         jax.config.update("jax_platforms", want)
 
-    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.config import AntidoteConfig, enable_compilation_cache
+
+    enable_compilation_cache()
     from antidote_tpu.crdt import get_type
     from antidote_tpu.store import TypedTable
 
@@ -229,7 +231,11 @@ def child(args):
         snap_versions=2,
         set_slots=16,
         keys_per_table=(n_keys + n_shards - 1) // n_shards,
-        batch_buckets=(4096, 16384),
+        # fine write buckets + exact serve buckets: a 1k-op Zipfian append
+        # (with its hot-key GC chunking) must not pad to the 16k serve
+        # shape — that padded the per-chunk head fold 16x (r4 mixed-load
+        # collapse, VERDICT item 2)
+        batch_buckets=(256, 1024, 4096, 8192, 16384),
         use_pallas=use_pallas,
     )
     ty = get_type("set_aw")
@@ -293,6 +299,10 @@ def child(args):
         final_t = clock0 + nrm
         final_clock = np.zeros(d, np.int32)
         final_clock[0] = final_t
+        # pin the serving epoch at the loaded snapshot — the GST pin a
+        # serving deployment performs; mixed-phase reads at final_clock
+        # stay pure gathers while appends advance the live head
+        table.publish_epoch()
         mid_t = int(total * 0.6)  # historical point: 60% through the add stream
         mid_clock = np.zeros(d, np.int32)
         mid_clock[0] = mid_t
@@ -500,7 +510,7 @@ def child(args):
     # not only correctness-tested (run LAST: the writes advance the table
     # past the clocks the earlier phases and the spot check read at)
     write_batch = max(256, serve_batch // 16)
-    mixed_batches = max(8, serve_batches // 2)
+    mixed_batches = max(8, serve_batches)
     writes = 0
 
     def mixed_append(i):
@@ -517,8 +527,11 @@ def child(args):
         writes += write_batch
 
     with phase("warmup_mixed"):
-        # compile the append/GC/stale-serve shapes outside the timer
-        mixed_append(-1)
+        # compile the append/GC/stale-serve shapes outside the timer —
+        # several appends, because Zipfian hot-key chunking exercises a
+        # family of (row-bucket, fold-window) shapes, not one
+        for wi in range(6):
+            mixed_append(-1 - wi)
         r0, _, _ = serve_one(0)
         np.asarray(r0["top"])
     with phase("mixed_load"):
@@ -536,7 +549,7 @@ def child(args):
             np.asarray(mq.popleft()["top"])
         mixed_elapsed = time.perf_counter() - t0
     mixed_read_rps = mixed_batches * serve_batch / mixed_elapsed
-    mixed_write_rps = (writes - write_batch) / mixed_elapsed  # minus warmup
+    mixed_write_rps = (writes - 6 * write_batch) / mixed_elapsed  # minus warmup
     log(f"mixed load: {mixed_read_rps:,.0f} reads/s + "
         f"{mixed_write_rps:,.0f} appends/s sustained")
 

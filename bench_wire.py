@@ -8,6 +8,15 @@ updates, reporting ops/s + latency percentiles.  This does the same
 against a `console serve` node over real sockets — every measured op
 crosses the wire, so the numbers are server-side end-to-end.
 
+Driver shape (r4 VERDICT item 3): workers are spread over several
+CLIENT PROCESSES (basho_bench's model — its workers are Erlang
+processes, not one interpreter), because a single CPython process
+caps at a few thousand ops/s of encode/decode regardless of server
+capacity.  Before the timed window the same concurrent load runs
+untimed, so the server's XLA shape family (batch buckets, fold
+windows, GC) is compiled before measurement — the reference's BEAM
+has no compile debt, so ramp-up must not be billed to the server.
+
     python bench_wire.py [--smoke] [--config N] [--json PATH]
 
 Configs mirror BASELINE.json:
@@ -38,9 +47,11 @@ import time
 
 import numpy as np
 
+HOST, PORT = "127.0.0.1", 0
 
-def _percentiles(lat):
-    a = np.asarray(lat) * 1e3
+
+def _percentiles(lat_ms):
+    a = np.asarray(lat_ms)
     return {
         "p50_ms": round(float(np.percentile(a, 50)), 3),
         "p99_ms": round(float(np.percentile(a, 99)), 3),
@@ -56,11 +67,16 @@ def _env():
     return env
 
 
-def _spawn_server(shards: int):
+def _spawn_server(shards: int, keys_hint: int = 0):
+    cmd = [sys.executable, "-m", "antidote_tpu.console", "serve",
+           "--port", "0", "--shards", str(shards), "--max-dcs", "2"]
+    if keys_hint:
+        # size the tables near the keyspace: growth doublings mid-run
+        # reallocate the device tables and recompile every serving shape
+        cmd += ["--keys-per-table",
+                str(max(1024, (keys_hint + shards - 1) // shards))]
     p = subprocess.Popen(
-        [sys.executable, "-m", "antidote_tpu.console", "serve",
-         "--port", "0", "--shards", str(shards), "--max-dcs", "2"],
-        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cmd, env=_env(), stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
     )
     line = p.stdout.readline().decode()
     info = json.loads(line)
@@ -100,22 +116,94 @@ def _spawn_cluster(shards: int):
     return procs, info
 
 
-def _run_workers(n_workers, duration_s, op_fn):
-    """Each worker loops op_fn(worker_rng) for duration_s; returns
-    (ops_done, latencies)."""
+# ---------------------------------------------------------------------------
+# workloads — module-level so worker-child processes can rebuild them
+# ---------------------------------------------------------------------------
+def _op_counter(c, rng, k, is_read):
+    if is_read:
+        c.read_objects([(k, "counter_pn", "b")])
+    else:
+        c.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+
+
+def _op_register(c, rng, k, is_read):
+    t = "register_lww" if k % 2 else "register_mv"
+    if is_read:
+        c.read_objects([(k, t, "b")])
+    else:
+        c.update_objects([(k, t, "b", ("assign", f"v{k}"))])
+
+
+def _op_set_aw(c, rng, k, is_read):
+    if is_read:
+        c.read_objects([(k, "set_aw", "b")])
+    elif rng.random() < 0.8:
+        c.update_objects([(k, "set_aw", "b",
+                           ("add", int(rng.integers(1 << 30))))])
+    else:
+        c.update_objects([(k, "set_aw", "b",
+                           ("remove", int(rng.integers(1 << 30))))])
+
+
+def _op_map_rr(c, rng, k, is_read):
+    if is_read:
+        c.read_objects([(f"m{k}", "map_rr", "b")])
+    else:
+        # dict ops ride the wire as pair lists (codec encode_value)
+        c.update_objects([(f"m{k}", "map_rr", "b", ("update", [
+            (("clicks", "counter_pn"), ("increment", 1)),
+            (("name", "register_lww"), ("assign", f"u{k}")),
+        ]))])
+
+
+CONFIGS = {
+    1: {"name": "counter_pn_10k_9r1w", "op": "counter",
+        "keys": (1000, 10_000), "zipf": False},
+    2: {"name": "register_lww_mv", "op": "register",
+        "keys": (1000, 10_000), "zipf": False},
+    3: {"name": "set_aw_zipf_north_star", "op": "set_aw",
+        "keys": (20_000, 200_000), "zipf": True},
+    4: {"name": "map_rr_nested", "op": "map_rr",
+        "keys": (500, 2_000), "zipf": False},
+}
+
+OP_FNS = {"counter": _op_counter, "register": _op_register,
+          "set_aw": _op_set_aw, "map_rr": _op_map_rr}
+
+
+def _make_op(opname: str, n_keys: int, zipf: bool, read_frac: float):
+    fn = OP_FNS[opname]
+    if zipf:
+        w = 1.0 / np.arange(1, n_keys + 1) ** 1.0
+        cdf = np.cumsum(w / w.sum())
+
+        def keygen(rng):
+            return int(np.searchsorted(cdf, rng.random()))
+    else:
+        def keygen(rng):
+            return int(rng.integers(n_keys))
+
+    def op(c, rng):
+        fn(c, rng, keygen(rng), rng.random() < read_frac)
+
+    return op
+
+
+def _run_threads(host, port, op, n_workers, duration_s, seed0):
+    """n_workers client threads in THIS process; returns (ops, lat_ms)."""
     stop = time.perf_counter() + duration_s
     counts = [0] * n_workers
     lats = [[] for _ in range(n_workers)]
     errs = []
 
     def worker(i):
-        rng = np.random.default_rng(1000 + i)
+        rng = np.random.default_rng(seed0 + i)
         try:
             from antidote_tpu.proto.client import AntidoteClient
-            c = AntidoteClient(HOST, PORT)
+            c = AntidoteClient(host, port)
             while time.perf_counter() < stop:
                 t0 = time.perf_counter()
-                op_fn(c, rng)
+                op(c, rng)
                 lats[i].append(time.perf_counter() - t0)
                 counts[i] += 1
             c.close()
@@ -128,50 +216,89 @@ def _run_workers(n_workers, duration_s, op_fn):
     for t in ts:
         t.join(timeout=duration_s + 60)
     assert not errs, errs
-    return sum(counts), [x for l in lats for x in l]
+    return sum(counts), [x * 1e3 for l in lats for x in l]
 
 
-HOST, PORT = "127.0.0.1", 0
+def _worker_child(args) -> int:
+    cfg = CONFIGS[args.config]
+    op = _make_op(cfg["op"], args.keys, cfg["zipf"], args.read_frac)
+    ops, lat_ms = _run_threads(args.host, args.port, op,
+                               args.workers, args.duration, args.seed)
+    # downsample latencies so the pipe stays bounded
+    if len(lat_ms) > 20_000:
+        idx = np.linspace(0, len(lat_ms) - 1, 20_000).astype(int)
+        lat_ms = list(np.asarray(lat_ms)[idx])
+    print(json.dumps({"ops": ops, "lat_ms": lat_ms}))
+    return 0
 
 
-def bench_config(name, n_keys, mk_op, smoke, workers=8, read_frac=0.9,
-                 zipf=False, prepopulate=None, spawn=None):
+def _run_workers_mp(cfg_id, n_keys, read_frac, workers, duration_s,
+                    n_procs):
+    """Spread ``workers`` threads over ``n_procs`` client processes
+    (basho_bench's many-OS-process shape — one CPython interpreter
+    saturates its GIL long before the server saturates)."""
+    per = max(1, workers // n_procs)
+    procs = []
+    workers_actual = per * n_procs
+    for p in range(n_procs):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker-child",
+             "--config", str(cfg_id), "--keys", str(n_keys),
+             "--read-frac", str(read_frac), "--host", HOST,
+             "--port", str(PORT), "--workers", str(per),
+             "--duration", str(duration_s), "--seed", str(1000 + 100 * p)],
+            env=_env(), stdout=subprocess.PIPE,
+        ))
+    ops, lat = 0, []
+    fails = []
+    for p in procs:
+        out, _ = p.communicate(timeout=duration_s + 120)
+        if p.returncode != 0:
+            fails.append(p.returncode)
+            continue
+        d = json.loads(out.decode().strip().splitlines()[-1])
+        ops += d["ops"]
+        lat.extend(d["lat_ms"])
+    assert not fails, f"worker children failed: {fails}"
+    return ops, lat, workers_actual
+
+
+def bench_config(cfg_id, smoke, workers=32, read_frac=0.9, spawn=None,
+                 tag=""):
     global HOST, PORT
-    procs, info = (spawn or _spawn_server)(16)
+    cfg = CONFIGS[cfg_id]
+    n_keys = cfg["keys"][0] if smoke else cfg["keys"][1]
+    if spawn is None:
+        procs, info = _spawn_server(16, keys_hint=n_keys)
+    else:
+        procs, info = spawn(16)
     HOST, PORT = info["host"], info["port"]
+    workers = 4 if smoke else workers
+    # this image is a 1-core host: a couple of driver processes already
+    # saturates the core; more would only thrash the server's scheduler
+    n_procs = 2 if smoke else max(2, min(4, os.cpu_count() or 1))
     try:
-        from antidote_tpu.proto.client import AntidoteClient
-
-        c = AntidoteClient(HOST, PORT)
-        if prepopulate:
-            prepopulate(c)
-        c.close()
-        if zipf:
-            w = 1.0 / np.arange(1, n_keys + 1) ** 1.0
-            cdf = np.cumsum(w / w.sum())
-
-            def keygen(rng):
-                return int(np.searchsorted(cdf, rng.random()))
-        else:
-            def keygen(rng):
-                return int(rng.integers(n_keys))
-
-        def op(c, rng):
-            mk_op(c, rng, keygen(rng), rng.random() < read_frac)
-
-        # warm (compile) outside the timed window
-        cw = AntidoteClient(HOST, PORT)
-        r = np.random.default_rng(0)
-        for _ in range(30):
-            op(cw, r)
-        cw.close()
+        # warm UNTIMED with the same concurrency until the latency tail
+        # quiets: the server compiles its (bucket, window, fold) shape
+        # family on first contact, and each compile is a multi-second
+        # outage on a small host — measurement starts at steady state
+        # (DB ramp-up, not billed), capped so a pathological tail can't
+        # stall the driver
+        for _ in range(2 if smoke else 8):
+            _, wlat, _ = _run_workers_mp(cfg_id, n_keys, read_frac, workers,
+                                         3, n_procs)
+            if wlat and float(np.percentile(wlat, 99)) < 50.0:
+                break
         dur = 3 if smoke else 10
-        ops, lat = _run_workers(2 if smoke else workers, dur, op)
+        ops, lat, workers_actual = _run_workers_mp(
+            cfg_id, n_keys, read_frac, workers, dur, n_procs
+        )
         out = {
-            "config": name,
+            "config": cfg["name"] + tag,
             "ops_per_s": round(ops / dur, 1),
             "n_ops": ops,
-            "workers": 2 if smoke else workers,
+            "workers": workers_actual,
+            "driver_procs": n_procs,
             "duration_s": dur,
             "read_fraction": read_frac,
             **_percentiles(lat),
@@ -193,77 +320,32 @@ def main():
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--config", type=int, default=None, help="1..4")
     ap.add_argument("--json", default=None)
+    ap.add_argument("--workers", type=int, default=32)
     ap.add_argument("--cluster", action="store_true",
                     help="drive a 2-member DC instead of a single node")
+    # worker-child mode (internal)
+    ap.add_argument("--worker-child", action="store_true")
+    ap.add_argument("--keys", type=int, default=0)
+    ap.add_argument("--read-frac", type=float, default=0.9)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=1000)
     args = ap.parse_args()
+    if args.worker_child:
+        sys.exit(_worker_child(args))
     smoke = args.smoke
     spawn = _spawn_cluster if args.cluster else None
     tag = "_cluster" if args.cluster else ""
 
     results = []
-
-    def cfg1():
-        n = 1000 if smoke else 10_000
-
-        def op(c, rng, k, is_read):
-            if is_read:
-                c.read_objects([(k, "counter_pn", "b")])
-            else:
-                c.update_objects([(k, "counter_pn", "b", ("increment", 1))])
-
-        results.append(bench_config("counter_pn_10k_9r1w" + tag, n, op, smoke, spawn=spawn))
-
-    def cfg2():
-        n = 1000 if smoke else 10_000
-
-        def op(c, rng, k, is_read):
-            t = "register_lww" if k % 2 else "register_mv"
-            if is_read:
-                c.read_objects([(k, t, "b")])
-            else:
-                c.update_objects([(k, t, "b", ("assign", f"v{k}"))])
-
-        results.append(bench_config("register_lww_mv" + tag, n, op, smoke, spawn=spawn))
-
-    def cfg3():
-        n = 20_000 if smoke else 200_000
-
-        def op(c, rng, k, is_read):
-            if is_read:
-                c.read_objects([(k, "set_aw", "b")])
-            elif rng.random() < 0.8:
-                c.update_objects([(k, "set_aw", "b",
-                                   ("add", int(rng.integers(1 << 30))))])
-            else:
-                c.update_objects([(k, "set_aw", "b",
-                                   ("remove", int(rng.integers(1 << 30))))])
-
-        results.append(bench_config(
-            "set_aw_zipf_north_star" + tag, n, op, smoke, zipf=True,
-            spawn=spawn))
-
-    def cfg4():
-        n = 500 if smoke else 2_000
-
-        def op(c, rng, k, is_read):
-            if is_read:
-                c.read_objects([(f"m{k}", "map_rr", "b")])
-            else:
-                # dict ops ride the wire as pair lists (codec encode_value)
-                c.update_objects([(f"m{k}", "map_rr", "b", ("update", [
-                    (("clicks", "counter_pn"), ("increment", 1)),
-                    (("name", "register_lww"), ("assign", f"u{k}")),
-                ]))])
-
-        results.append(bench_config("map_rr_nested" + tag, n, op, smoke, spawn=spawn))
-
-    cfgs = {1: cfg1, 2: cfg2, 3: cfg3, 4: cfg4}
-    for i, fn in sorted(cfgs.items()):
-        if args.config in (None, i):
-            fn()
+    ids = [args.config] if args.config else [1, 2, 3, 4]
+    for cid in ids:
+        results.append(bench_config(cid, smoke, workers=args.workers,
+                                    spawn=spawn, tag=tag))
     if args.json:
         with open(args.json, "w") as f:
-            json.dump(results, f, indent=1)
+            json.dump({"results": results}, f, indent=2)
     return 0
 
 

@@ -148,3 +148,84 @@ def test_concurrent_clients(server):
     vals, _ = c.read_objects([("conc", "counter_pn", "b")])
     c.close()
     assert vals[0] == n_clients * n_ops
+
+
+# ---------------------------------------------------------------------------
+# cross-connection static batch gate (r4 VERDICT item 3)
+# ---------------------------------------------------------------------------
+def test_static_batch_concurrent_reads_and_updates():
+    import threading
+
+    from antidote_tpu.api.node import AntidoteNode
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.server import ProtocolServer
+
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=64,
+                         batch_buckets=(16, 64))
+    node = AntidoteNode(cfg)
+    srv = ProtocolServer(node, port=0)
+    assert srv.batch_static
+    try:
+        n_cli, per = 8, 12
+        errs = []
+
+        def worker(i):
+            try:
+                c = AntidoteClient(srv.host, srv.port)
+                for j in range(per):
+                    c.update_objects([(i * 1000 + j, "counter_pn", "b",
+                                       ("increment", 1))])
+                    vals, _vc = c.read_objects(
+                        [(i * 1000 + j, "counter_pn", "b")])
+                    assert vals[0] == 1, vals
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(n_cli)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs, errs
+        # all writes landed: a single merged read sees every counter
+        c = AntidoteClient(srv.host, srv.port)
+        objs = [(i * 1000 + j, "counter_pn", "b")
+                for i in range(n_cli) for j in range(per)]
+        vals, _vc = c.read_objects(objs)
+        assert all(v == 1 for v in vals)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_group_commit_abort_isolation():
+    """Two conflicting updates in one group: first commits, second aborts;
+    an unrelated update in the same group is untouched."""
+    import numpy as np
+
+    from antidote_tpu.api.node import AntidoteNode
+    from antidote_tpu.config import AntidoteConfig
+    from antidote_tpu.txn.manager import AbortError
+
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=64,
+                         batch_buckets=(16, 64))
+    node = AntidoteNode(cfg)
+    txm = node.txm
+    # stage two txns on the same key with the same snapshot, plus one
+    # disjoint — drive the group commit directly
+    t1 = txm.start_transaction()
+    t2 = txm.start_transaction()
+    t3 = txm.start_transaction()
+    txm.update_objects([("k", "counter_pn", "b", ("increment", 1))], t1)
+    txm.update_objects([("k", "counter_pn", "b", ("increment", 5))], t2)
+    txm.update_objects([("x", "counter_pn", "b", ("increment", 9))], t3)
+    outs = txm.commit_transactions_group([t1, t2, t3])
+    assert isinstance(outs[0], np.ndarray)
+    assert isinstance(outs[1], AbortError)
+    assert isinstance(outs[2], np.ndarray)
+    vals, _ = node.read_objects(
+        [("k", "counter_pn", "b"), ("x", "counter_pn", "b")]
+    )
+    assert vals == [1, 9]
