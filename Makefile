@@ -1,0 +1,33 @@
+# Operator/CI entrypoints (reference analogue: /root/reference/Makefile:79-111
+# — compile/test/dialyzer/elvis).  This image has no third-party
+# linter, so `lint` runs the stdlib AST gate; ruff/mypy configs live in
+# pyproject.toml for hosts that have them.
+
+PY ?= python
+
+.PHONY: test smoke lint bench bench-wire multichip all
+
+all: lint smoke
+
+# full suite (serial; ~10-12 min on the 1-core CI host)
+test:
+	$(PY) -m pytest tests/ -q
+
+# fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
+# wire codecs, store tables, observability, console, supervision
+smoke:
+	$(PY) -m pytest -q -m smoke
+
+lint:
+	$(PY) tools/lint.py
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; fi
+
+bench:
+	$(PY) bench.py
+
+bench-wire:
+	$(PY) bench_wire.py
+
+multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -23,13 +23,12 @@ from __future__ import annotations
 
 import itertools
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
-
+from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from antidote_tpu.cluster.member import (ClusterMember, _freeze_op,
                                          unwire_value)
-from antidote_tpu.cluster.rpc import RpcError, eff_to_wire
+from antidote_tpu.cluster.rpc import eff_to_wire
 from antidote_tpu.crdt import get_type, is_type
 from antidote_tpu.store.kv import Effect, freeze_key, key_to_shard
 from antidote_tpu.txn.manager import AbortError
@@ -91,7 +90,26 @@ class ClusterNode:
         """Peer member id owning a shard; None when it is mine."""
         if shard in self.member.shards:
             return None
-        return shard % self.member.n_members
+        owner = self.member.shard_map.get(shard,
+                                          shard % self.member.n_members)
+        # a live import updates the shard set and the map in two steps;
+        # "the map says me" is the local member either way
+        return None if owner == self.member.member_id else owner
+
+    def _refresh_shard_map(self) -> None:
+        """Pull the current ownership map from any peer (after a
+        not_owner reply: a live join/leave moved a shard under us)."""
+        for mid, cli in list(self.member.peers.items()):
+            try:
+                m = cli.call("m_shard_map")
+            except Exception:
+                continue
+            with self.member._lock:
+                for s, owner in m.items():
+                    s = int(s)
+                    if s not in self.member.shards:
+                        self.member.shard_map[s] = int(owner)
+            return
 
     def _owner_of(self, key, bucket) -> Optional[int]:
         return self._owner_of_shard(
@@ -154,6 +172,21 @@ class ClusterNode:
         return self._read(objects, txn)
 
     def _read(self, objects, txn: ClusterTxn) -> list:
+        # a live shard move lands between routing and the owner call as a
+        # retryable not_owner/busy reply; the map refresh + retry rides
+        # out the one-shard move window (the only blocking riak_core
+        # handoff also imposes)
+        for _ in range(200):
+            try:
+                return self._read_routed(objects, txn)
+            except RuntimeError as e:
+                if "not_owner" not in str(e) and "busy" not in str(e):
+                    raise
+                self._refresh_shard_map()
+                time.sleep(0.02)
+        raise RuntimeError("shard ownership unstable: read retries exhausted")
+
+    def _read_routed(self, objects, txn: ClusterTxn) -> list:
         assert txn.active
         out: List[Any] = [None] * len(objects)
         # composite (map) objects assemble recursively: ONE membership
@@ -299,8 +332,9 @@ class ClusterNode:
                 # the txn's own pending effects for the key overlaid
                 # (observed-remove must see same-txn adds); incremental
                 # shipping with a full-resend fallback on overlay-resync
-                owner = self._owner_of(key, bucket)
-                for full in (False, True):
+                full, moves = False, 0
+                while True:
+                    owner = self._owner_of(key, bucket)
                     overlay = self._overlay_payload(txn, key, bucket,
                                                     full=full)
                     try:
@@ -318,6 +352,17 @@ class ClusterNode:
                     except RuntimeError as e:
                         if (not full and overlay is not None
                                 and "overlay-resync" in str(e)):
+                            full = True
+                            continue
+                        if ("not_owner" in str(e) or "busy" in str(e)) \
+                                and moves < 200:
+                            # live shard move in flight: refresh + retry
+                            # (the new owner has no overlay prefix —
+                            # resend in full)
+                            moves += 1
+                            full = True
+                            self._refresh_shard_map()
+                            time.sleep(0.02)
                             continue
                         if "abort" in str(e):
                             self.abort_transaction(txn)
@@ -355,40 +400,53 @@ class ClusterNode:
         self._txns.pop(txn.txid, None)
         if not txn.writeset:
             return txn.snapshot_vc.copy()
-        by_owner: Dict[Optional[int], list] = {}
-        shards = set()
-        for eff in txn.writeset:
-            shard = key_to_shard(eff.key, eff.bucket, self.cfg.n_shards)
-            shards.add(shard)
-            by_owner.setdefault(self._owner_of_shard(shard), []).append(eff)
         snap_own = int(txn.snapshot_vc[self.dc_id])
-        prepared: List[Optional[int]] = []
-        try:
-            for owner, effs in by_owner.items():
-                wires = [eff_to_wire(e) for e in effs]
-                if owner is None:
-                    self.member.m_prepare(txn.txid, wires, snap_own)
-                else:
-                    self.member.peers[owner].call(
-                        "m_prepare", txn.txid, wires, snap_own
-                    )
-                prepared.append(owner)
-        except RuntimeError as e:
-            # cert conflicts raise "abort: ..." — locally as RuntimeError,
-            # remotely surfaced through RpcError (a RuntimeError subclass)
-            self._abort_prepared(txn.txid, prepared)
-            # a conflict means another coordinator committed past our
-            # snapshot: invalidate the cached sequencer frontier so the
-            # client's RETRY starts from a snapshot that can pass
-            # certification instead of re-aborting for up to the whole
-            # cache-refresh window
-            self.member.invalidate_seq_cache()
-            if "abort" in str(e):
-                raise AbortError(str(e)) from e
-            raise
-        except Exception:
-            self._abort_prepared(txn.txid, prepared)
-            raise
+        for moves in range(200):
+            by_owner: Dict[Optional[int], list] = {}
+            shards = set()
+            for eff in txn.writeset:
+                shard = key_to_shard(eff.key, eff.bucket, self.cfg.n_shards)
+                shards.add(shard)
+                by_owner.setdefault(self._owner_of_shard(shard),
+                                    []).append(eff)
+            prepared: List[Optional[int]] = []
+            try:
+                for owner, effs in by_owner.items():
+                    wires = [eff_to_wire(e) for e in effs]
+                    if owner is None:
+                        self.member.m_prepare(txn.txid, wires, snap_own)
+                    else:
+                        self.member.peers[owner].call(
+                            "m_prepare", txn.txid, wires, snap_own
+                        )
+                    prepared.append(owner)
+                break
+            except RuntimeError as e:
+                # cert conflicts raise "abort: ..." — locally as
+                # RuntimeError, remotely through RpcError (a RuntimeError
+                # subclass)
+                self._abort_prepared(txn.txid, prepared)
+                if "not_owner" in str(e) or "busy" in str(e):
+                    # live shard move in flight: re-route and re-prepare
+                    # (the aborts released any locks already taken)
+                    self._refresh_shard_map()
+                    time.sleep(0.02)
+                    continue
+                # a conflict means another coordinator committed past our
+                # snapshot: invalidate the cached sequencer frontier so
+                # the client's RETRY starts from a snapshot that can pass
+                # certification instead of re-aborting for up to the
+                # whole cache-refresh window
+                self.member.invalidate_seq_cache()
+                if "abort" in str(e):
+                    raise AbortError(str(e)) from e
+                raise
+            except Exception:
+                self._abort_prepared(txn.txid, prepared)
+                raise
+        else:
+            raise RuntimeError(
+                "shard ownership unstable: prepare retries exhausted")
         # one DC-wide timestamp + per-shard chains from the sequencer
         # (ledgered under the txid so takeover can find this txn)
         ts, prev = self._seq(sorted(shards), txn.txid)

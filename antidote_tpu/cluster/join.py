@@ -1,0 +1,130 @@
+"""Live cluster membership change: join/leave while the DC serves.
+
+The riak_core staged join + ownership handoff analogue
+(/root/reference/src/antidote_dc_manager.erl:53-81 — plan/commit over
+node names; materializer handoff fold,
+/root/reference/src/materializer_vnode.erl:221-246).  The tensor
+rebuild's unit of handoff is the SHARD (a full slice of every device
+table + its WAL chain), and the protocol moves shards one at a time:
+
+  1. the joiner boots EMPTY (``ClusterMember(..., shards=[])``) and is
+     wired to every member (operator / ctl_wire);
+  2. every member learns the joiner + new member count (m_join_begin);
+  3. for each shard whose modular owner changes under the new count:
+     the source exports-and-relinquishes it under its lock (refusing,
+     retryably, while staged txns or chain holes touch the shard), the
+     destination imports it, everyone else learns the new owner;
+  4. the layout converges to the modular map for the new count.
+
+While a shard is mid-move, coordinators hitting it get retryable
+``not_owner``/``busy`` replies and re-route off a refreshed shard map —
+the move blocks ONE shard briefly, never the cluster (riak_core vnode
+handoff has the same per-vnode pause).  A member crash mid-join
+recovers from its prepare log: ownership changes are durable "own"
+events, so rejoin comes back with the moved layout.
+
+``live_leave`` is the inverse: the LAST member id streams its shards
+back to the modular layout of the smaller count, then shuts down.
+(Leaving an arbitrary member id would renumber everyone — that remains
+the offline resize tool's job.)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+from antidote_tpu.cluster.rpc import RpcClient
+
+#: per-shard move retry budget (a staged txn pins a shard only for the
+#: prepare→commit window; 400 × 25 ms rides out seconds of contention)
+_MOVE_TRIES = 400
+
+
+def _retry_call(cli: RpcClient, method: str, *args, tries: int = _MOVE_TRIES):
+    last = None
+    for _ in range(tries):
+        try:
+            return cli.call(method, *args)
+        except Exception as e:
+            if "busy" in str(e):
+                last = e
+                time.sleep(0.025)
+                continue
+            raise
+    raise TimeoutError(f"{method}: shard stayed busy") from last
+
+
+def _move_shard(clients: Dict[int, RpcClient], shard: int, src: int,
+                dst: int, n_members: int) -> None:
+    data = _retry_call(clients[src], "m_export_shard", shard, dst)
+    # the package is the ONLY copy until the import lands: retry the
+    # import (idempotent at the destination), never re-export
+    last = None
+    for _ in range(10):
+        try:
+            clients[dst].call("m_import_shard", data)
+            break
+        except Exception as e:  # transient RPC hiccup
+            last = e
+            time.sleep(0.1)
+    else:
+        raise RuntimeError(
+            f"shard {shard} import at member {dst} kept failing"
+        ) from last
+    for m, c in clients.items():
+        if m not in (src, dst):
+            c.call("m_set_owner", shard, dst, n_members)
+
+
+def plan_moves(shard_map: Dict[int, int], n_new: int
+               ) -> List[Tuple[int, int, int]]:
+    """(shard, src, dst) for every shard whose owner changes under the
+    modular layout of ``n_new`` members."""
+    return [(s, o, s % n_new) for s, o in sorted(shard_map.items())
+            if o != s % n_new]
+
+
+def live_join(rpcs: Dict[int, Tuple[str, int]], new_id: int) -> int:
+    """Join member ``new_id`` (already booted empty and wired) into a
+    serving cluster.  ``rpcs``: member_id -> RPC address for EVERY
+    member including the joiner.  Returns the number of shards moved."""
+    clients = {m: RpcClient(*a) for m, a in rpcs.items()}
+    try:
+        n_new = max(rpcs) + 1
+        for m, c in clients.items():
+            c.call("m_join_begin", new_id, list(rpcs[new_id]), n_new)
+        cur = {int(s): int(o)
+               for s, o in clients[0].call("m_shard_map").items()}
+        moves = plan_moves(cur, n_new)
+        for shard, src, dst in moves:
+            _move_shard(clients, shard, src, dst, n_new)
+        return len(moves)
+    finally:
+        for c in clients.values():
+            c.close()
+
+
+def live_leave(rpcs: Dict[int, Tuple[str, int]], leaving_id: int) -> int:
+    """Drain the LAST member id's shards back to the smaller modular
+    layout; the caller shuts the leaver down afterwards."""
+    if leaving_id != max(rpcs):
+        raise ValueError(
+            "live leave drains the highest member id (leaving an "
+            "arbitrary id renumbers the modular layout — use the "
+            "offline resize tool for that)")
+    clients = {m: RpcClient(*a) for m, a in rpcs.items()}
+    try:
+        n_new = leaving_id
+        cur = {int(s): int(o)
+               for s, o in clients[0].call("m_shard_map").items()}
+        moves = plan_moves(cur, n_new)
+        for shard, src, dst in moves:
+            _move_shard(clients, shard, src, dst, n_new)
+        for m, c in clients.items():
+            if m != leaving_id:
+                c.call("m_join_begin", leaving_id, ["", 0], n_new)
+        return len(moves)
+    finally:
+        for c in clients.values():
+            c.close()

@@ -174,18 +174,28 @@ class ClusterMember:
         self.n_members = n_members
         self.shards = set(shards if shards is not None
                           else owned_shards(cfg, member_id, n_members))
-        if (n_members > 1
+        if (n_members > 1 and self.shards
                 and self.shards != set(owned_shards(cfg, member_id,
                                                     n_members))):
-            # takeover's 2PC safety check derives "which members own the
-            # txn's shards" from the modular layout (s % n_members); a
-            # deviating assignment would make it poll the wrong members'
-            # reachability and risk aborting behind a live owner's back
+            # the DEFAULT layout is modular; arbitrary static assignments
+            # would desynchronize every member's shard_map.  (An EMPTY
+            # set is the live-join boot state: the joiner owns nothing
+            # until shards stream over, cluster/join.py.)
             raise ValueError(
-                "multi-member DCs require the modular shard layout "
-                "(shard s owned by member s % n_members); custom "
-                "assignments would break coordinator-crash takeover's "
-                "involved-owner reachability check")
+                "multi-member DCs boot with the modular shard layout "
+                "(shard s owned by member s % n_members, or an empty set "
+                "for a live-joining member); ownership then moves only "
+                "through the live join/leave protocol so every member's "
+                "shard map stays consistent")
+        #: shard -> owning member id — the explicit ownership map (the
+        #: riak_core ring analogue).  Starts modular; live join/leave
+        #: updates it in lock-step with the data moves, and stale
+        #: coordinators converge through not_owner retry.
+        self.shard_map: Dict[int, int] = {
+            s: s % n_members for s in range(cfg.n_shards)
+        }
+        for s in self.shards:
+            self.shard_map[s] = member_id
         self.node = AntidoteNode(cfg, dc_id=dc_id, log_dir=log_dir,
                                  recover=recover, meta=meta)
         self._coordinator = None
@@ -237,8 +247,22 @@ class ClusterMember:
             from antidote_tpu.log.wal import ShardWAL
 
             os.makedirs(log_dir, exist_ok=True)
+            fresh = not os.path.exists(os.path.join(log_dir, "prepare.wal"))
             self._prep_wal = ShardWAL(os.path.join(log_dir, "prepare.wal"),
                                       sync_on_commit=cfg.sync_log)
+            if fresh and not recover:
+                # durable boot layout: recovery derives ownership from
+                # THIS + the own-event trail, never from the (possibly
+                # since-grown) member count passed at recover time — a
+                # member crashing mid-live-join must come back owning
+                # exactly what it durably owned.  The ACTUAL shard set
+                # is recorded (a joiner boots with an EMPTY set, not the
+                # modular share of its member count)
+                self._prep_append({"ev": "boot_layout", "txid": 0,
+                                   "n": int(n_members),
+                                   "member": int(member_id),
+                                   "shards": sorted(int(s)
+                                                    for s in self.shards)})
         self._seq_cache = 0
         self._seq_cache_at = 0.0
         if recover:
@@ -256,7 +280,8 @@ class ClusterMember:
                      "m_ready", "m_seq_counter", "m_txn_status",
                      "m_block_txn", "m_forget_txn", "m_resolve_chain",
                      "m_txn_sequenced", "m_resolve_stale_txn",
-                     "m_process_transfer"):
+                     "m_process_transfer", "m_shard_map", "m_join_begin",
+                     "m_export_shard", "m_import_shard", "m_set_owner"):
             self.rpc.register(name, getattr(self, name))
 
     def coordinator(self):
@@ -361,6 +386,39 @@ class ClusterMember:
             elif ev == "seq" and self.seq is not None:
                 self.seq.restore_issue(rec["ts"], txid, rec["shards"],
                                        rec["prev"])
+            elif ev == "boot_layout":
+                # authoritative starting ownership (own events below
+                # adjust it); overrides the modular guess from the
+                # recover-time member count.  Records lacking the
+                # explicit set predate it — fall back to modular(n).
+                n0 = int(rec["n"])
+                booted = rec.get("shards")
+                self.shards = (set(int(s) for s in booted)
+                               if booted is not None
+                               else set(owned_shards(self.cfg,
+                                                     self.member_id, n0)))
+                self.shard_map = {
+                    s: s % n0 for s in range(self.cfg.n_shards)
+                }
+                for s in self.shards:
+                    self.shard_map[s] = self.member_id
+                self.applied_ts = {s: 0 for s in self.shards}
+                self.chain_wait = {s: {} for s in self.shards}
+            elif ev == "own":
+                # live-membership ownership change (durable: a member
+                # crashing mid-join must rejoin with the moved layout)
+                s, owner = int(rec["shard"]), int(rec["owner"])
+                self.shard_map[s] = owner
+                if owner == self.member_id:
+                    self.shards.add(s)
+                    self.applied_ts.setdefault(s, 0)
+                    self.chain_wait.setdefault(s, {})
+                else:
+                    self.shards.discard(s)
+                    self.applied_ts.pop(s, None)
+                    self.chain_wait.pop(s, None)
+            elif ev == "members":
+                self.n_members = int(rec["n"])
         self._trim_ledgers()
         return pending
 
@@ -510,9 +568,11 @@ class ClusterMember:
         vc = self.node.store.applied_vc
         own = self.dc_id
         for s in self.shards:
-            if self.chain_wait[s] or self.prepared_on_shard(s):
+            # lock-free walk racing a live shard move: a popped entry
+            # means the shard just left this member — skip it
+            if self.chain_wait.get(s) or self.prepared_on_shard(s):
                 continue
-            if vc[s, own] < ctr:
+            if s in self.shards and vc[s, own] < ctr:
                 vc[s, own] = ctr
 
     def m_read_values(self, objects, read_vc, overlays=None) -> list:
@@ -536,8 +596,9 @@ class ClusterMember:
         want = int(read_vc[self.dc_id])
         shards = {
             key_to_shard(k, b, self.cfg.n_shards) for k, _, b in objs
-        } & self.shards
+        }
         for s in shards:
+            self._check_owner(s)
             self._wait_read_safe(s, want)
         with self._lock:
             if not overlays or not any(overlays):
@@ -691,8 +752,8 @@ class ClusterMember:
         # generated from a snapshot missing a committed-but-unapplied op
         # would break observed-remove semantics
         shard = key_to_shard(key, bucket, self.cfg.n_shards)
-        if shard in self.shards:
-            self._wait_read_safe(shard, int(read_vc[self.dc_id]))
+        self._check_owner(shard)
+        self._wait_read_safe(shard, int(read_vc[self.dc_id]))
         with self._lock:
             store = self.node.store
             state = store.read_states(
@@ -757,6 +818,118 @@ class ClusterMember:
             return 0  # lost a race for the rights; requester retries
         return grant
 
+    # ------------------------------------------------------------------
+    # live membership (the riak_core staged join/leave + ownership
+    # handoff analogue, /root/reference/src/antidote_dc_manager.erl:53-81
+    # + /root/reference/src/materializer_vnode.erl:221-246): shards move
+    # one at a time between members WHILE THE CLUSTER SERVES — a move
+    # briefly refuses new work on that one shard ("busy"/"not_owner"
+    # retryable errors), never the cluster
+    # ------------------------------------------------------------------
+    def _check_owner(self, shard: int) -> None:
+        if shard not in self.shards:
+            raise RuntimeError(
+                f"not_owner: shard {shard} owner "
+                f"{self.shard_map.get(shard, -1)}"
+            )
+
+    def m_shard_map(self) -> dict:
+        return {int(s): int(m) for s, m in self.shard_map.items()}
+
+    def m_join_begin(self, new_id: int, new_addr, n_members_new: int) -> bool:
+        """Learn a joining member: wire its RPC, grow the member count.
+        Ownership is untouched — shards move one by one afterwards."""
+        with self._lock:
+            self.n_members = int(n_members_new)
+            if new_id != self.member_id and new_id not in self.peers:
+                self.connect(int(new_id), new_addr[0], int(new_addr[1]))
+            self._prep_append({"ev": "members", "txid": 0,
+                               "n": int(n_members_new)})
+        return True
+
+    def m_set_owner(self, shard: int, owner: int,
+                    n_members: Optional[int] = None) -> bool:
+        """Record a completed shard move (driver broadcast).  The source
+        and destination already updated themselves durably in
+        export/import; everyone else updates the map here."""
+        with self._lock:
+            shard, owner = int(shard), int(owner)
+            if n_members is not None:
+                self.n_members = int(n_members)
+            self.shard_map[shard] = owner
+            if owner != self.member_id:
+                self.shards = self.shards - {shard}
+            self._prep_append({"ev": "own", "txid": 0, "shard": shard,
+                               "owner": owner})
+        return True
+
+    def m_export_shard(self, shard: int, target: int) -> bytes:
+        """Package + relinquish one shard for a live move.
+
+        Refuses (retryably) while any staged txn or chain hole touches
+        the shard — the prepare→commit window pins ownership, so a
+        coordinator never has to chase a staged txn across members.
+        After this returns, the shard's data exists ONLY in the returned
+        package until the target imports it: the driver must not drop
+        the bytes on the floor (crash recovery: the source's WAL still
+        holds the records until drop, and drop happens here — so the
+        DRIVER retries the import, never the export)."""
+        from antidote_tpu.store import handoff as _handoff
+
+        shard, target = int(shard), int(target)
+        with self._lock:
+            self._check_owner(shard)
+            for txid, st in self.staged.items():
+                effects = st[0]
+                for eff in effects:
+                    if key_to_shard(eff.key, eff.bucket,
+                                    self.cfg.n_shards) == shard:
+                        raise RuntimeError(
+                            f"busy: txn {txid} staged on shard {shard}")
+            if self.chain_wait.get(shard):
+                raise RuntimeError(f"busy: chain holes on shard {shard}")
+            pkg = _handoff.export_shard(self.node.store, shard)
+            pkg["applied_ts"] = int(self.applied_ts.get(shard, 0))
+            data = _handoff.pack(pkg)
+            _handoff.drop_shard(self.node.store, shard)
+            # copy-on-write: lock-free readers iterate the old set
+            self.shards = self.shards - {shard}
+            self.shard_map[shard] = target
+            self.applied_ts.pop(shard, None)
+            self.chain_wait.pop(shard, None)
+            self._prep_append({"ev": "own", "txid": 0, "shard": shard,
+                               "owner": target})
+        return data
+
+    def m_import_shard(self, data: bytes) -> bool:
+        """Install a moved shard and take ownership (idempotent: a
+        re-sent package for a shard I already own is a no-op)."""
+        from antidote_tpu.store import handoff as _handoff
+
+        pkg = _handoff.unpack(bytes(data))
+        shard = int(pkg["shard"])
+        with self._lock:
+            if shard in self.shards:
+                return True  # duplicate delivery after a driver retry
+            self.node.receive_handoff(pkg)
+            self.shards = self.shards | {shard}
+            self.shard_map[shard] = self.member_id
+            self.applied_ts[shard] = int(pkg.get("applied_ts", 0))
+            self.chain_wait[shard] = {}
+            # certification continuity for the moved keys (the member
+            # cert table, not just the node's): their last own-lane
+            # commit rides in each head clock
+            for key, bucket, tname, row in pkg["directory"]:
+                lane = int(np.asarray(
+                    pkg["tables"][tname]["head_vc"])[row][self.dc_id])
+                if lane:
+                    dk = (freeze_key(key), bucket)
+                    self.last_commit[dk] = max(
+                        self.last_commit.get(dk, 0), lane)
+            self._prep_append({"ev": "own", "txid": 0, "shard": shard,
+                               "owner": self.member_id})
+        return True
+
     def m_prepare(self, txid: int, effs_wire: list, snap_own: int) -> bool:
         """Certify + lock this txn's keys on my shards
         (certification_with_check, /root/reference/src/clocksi_vnode.erl:599-624).
@@ -765,6 +938,9 @@ class ClusterMember:
         with self._lock:
             keys = []
             for eff in effects:
+                self._check_owner(
+                    key_to_shard(eff.key, eff.bucket, self.cfg.n_shards)
+                )
                 dk = (eff.key, eff.bucket)
                 holder = self.prepared.get(dk)
                 if holder is not None and holder != txid:
@@ -1001,7 +1177,8 @@ class ClusterMember:
         restarting the node, multiple_dcs_node_failure_SUITE).  The
         block barrier shuts the door on a zombie coordinator racing the
         abort."""
-        involved = {int(s) % self.n_members for s in tx_shards}
+        involved = {self.shard_map.get(int(s), int(s) % self.n_members)
+                    for s in tx_shards}
         statuses = self._poll("m_txn_status", txid)
         for st in statuses.values():
             if st[0] == "committed":
@@ -1093,7 +1270,10 @@ class ClusterMember:
         for _ in range(max_rounds):
             progress = False
             for s in sorted(self.shards):
-                frontier = int(self.applied_ts[s])
+                frontier_v = self.applied_ts.get(s)
+                if frontier_v is None:
+                    continue  # shard moved away mid-walk (live join)
+                frontier = int(frontier_v)
                 if self.seq is not None:
                     dec = self.m_resolve_chain(s, frontier, grace_s)
                 else:

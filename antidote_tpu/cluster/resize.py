@@ -120,6 +120,20 @@ def resize_dc(old_dirs: List[str], new_dirs: List[str], dc_id: int = 0
         if n.store.log is not None:
             n.store.log.close()
 
+    # ---- layout-epoch guard (r4 VERDICT item 7): stamp the new layout's
+    # epoch into the new dirs and RETIRE the old ones — an old-dir member
+    # booted after the resize would serve (and extend) a stale copy of
+    # shards that now live elsewhere
+    from antidote_tpu.log import load_dir_meta, mark_dir_retired, \
+        stamp_layout_epoch
+
+    old_epoch = int((meta or {}).get("layout_epoch", 0))
+    new_epoch = old_epoch + 1
+    for d in new_dirs:
+        stamp_layout_epoch(d, new_epoch)
+    for d in old_dirs:
+        mark_dir_retired(d, new_epoch)
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="antidote_tpu.cluster.resize")

@@ -13,8 +13,7 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Callable, Dict, Tuple
-
+from typing import Any, Callable, Dict
 import msgpack
 import numpy as np
 

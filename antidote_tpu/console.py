@@ -87,12 +87,38 @@ def cmd_serve(args) -> int:
     # as supervised children; a flapping child takes the node down
     from antidote_tpu.supervise import Supervisor
 
+    interdc = None
+    if args.interdc:
+        # geo-replication plane: a TCP fabric + DCReplica so protocol
+        # clients can bootstrap a DC mesh (GetConnectionDescriptor /
+        # ConnectToDCs on either dialect)
+        import threading
+
+        from antidote_tpu.interdc import DCReplica
+        from antidote_tpu.interdc.tcp import TcpFabric
+
+        fabric = TcpFabric(host=args.host)
+        interdc = DCReplica(node, fabric, name=f"dc{args.dc_id}")
+        if recover:
+            interdc.restore_from_log()
+
+        def _pump():
+            while True:
+                try:
+                    fabric.pump(timeout=0.2)
+                except Exception as e:
+                    log(f"interdc pump error: {e!r}")
+                time.sleep(0.01)
+
+        threading.Thread(target=_pump, daemon=True,
+                         name="interdc-pump").start()
     sup = Supervisor(on_giveup=lambda name: os._exit(70))
     server_box = {}
 
     def start_proto():
         port = server_box["srv"].port if "srv" in server_box else args.port
-        server_box["srv"] = ProtocolServer(node, host=args.host, port=port)
+        server_box["srv"] = ProtocolServer(node, host=args.host, port=port,
+                                           interdc=interdc)
         return server_box["srv"]
 
     sup.add("proto", start_proto, alive=lambda s: s.is_alive(),
@@ -244,6 +270,10 @@ def main(argv=None) -> int:
     sv.add_argument("--max-dcs", type=int, default=None,
                     help="default: the log dir's recorded shape, else 8")
     sv.add_argument("--recover", action="store_true")
+    sv.add_argument("--interdc", action="store_true",
+                    help="attach the inter-DC replication plane (TCP "
+                         "fabric + replica) so clients can bootstrap a "
+                         "DC mesh over the protocol")
     sv.add_argument("--keys-per-table", type=int, default=4096,
                     help="initial rows per (type, shard); size near the "
                          "expected keyspace — every growth doubling "

@@ -23,7 +23,7 @@ value rather than a reset one.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, List, Tuple
 
 from antidote_tpu.crdt.base import CRDTType
 

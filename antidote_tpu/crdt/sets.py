@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from antidote_tpu.crdt.base import (CRDTType, Effect, TopCountResolved,
-                                    compact_top, pack_b, warn_overflow_state)
+                                    compact_top, warn_overflow_state)
 from antidote_tpu.crdt.blob import EMPTY_HANDLE
 
 

@@ -10,7 +10,7 @@ the effects ride along so the receiving DC can resolve value handles.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import msgpack
 import numpy as np
@@ -88,3 +88,21 @@ class Descriptor:
     name: str
     n_shards: int
     address: Optional[Tuple[str, int]] = None  # TCP transport endpoint
+    #: fabric endpoint identity — equals dc_id for single-member DCs;
+    #: cluster members advertise distinct fabric ids on one dc_id
+    fabric_id: Optional[int] = None
+
+    def to_wire(self) -> dict:
+        return {"dc_id": self.dc_id, "name": self.name,
+                "n_shards": self.n_shards,
+                "address": list(self.address) if self.address else None,
+                "fabric_id": self.fabric_id}
+
+    @staticmethod
+    def from_wire(d: dict) -> "Descriptor":
+        addr = d.get("address")
+        return Descriptor(
+            int(d["dc_id"]), d.get("name", ""), int(d["n_shards"]),
+            (addr[0], int(addr[1])) if addr else None,
+            None if d.get("fabric_id") is None else int(d["fabric_id"]),
+        )

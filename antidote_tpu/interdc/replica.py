@@ -196,13 +196,44 @@ class DCReplica:
         return contextlib.nullcontext()
 
     def descriptor(self) -> Descriptor:
-        return Descriptor(self.dc_id, self.name, self.node.cfg.n_shards)
+        """Shareable connection descriptor
+        (inter_dc_manager:get_descriptor,
+        /root/reference/src/inter_dc_manager.erl:49-61).  Carries the
+        transport endpoint when the hub has one (TcpFabric), so another
+        process/deployment can subscribe from the descriptor alone."""
+        addr = None
+        address_of = getattr(self.hub, "address_of", None)
+        if address_of is not None:
+            try:
+                addr = tuple(address_of(self.fabric_id))
+            except KeyError:
+                addr = None
+        return Descriptor(self.dc_id, self.name, self.node.cfg.n_shards,
+                          addr, self.fabric_id)
 
     def observe_dc(self, remote: "DCReplica") -> None:
         """Subscribe to a remote DC's txn stream
         (inter_dc_manager:observe_dcs_sync,
         /root/reference/src/inter_dc_manager.erl:67-109)."""
         self.hub.subscribe(self.fabric_id, remote.fabric_id, self._on_message)
+
+    def observe_descriptor(self, desc) -> None:
+        """Subscribe from a wire descriptor (dict or Descriptor) — the
+        cross-process form of :meth:`observe_dc`
+        (antidote_dc_manager:subscribe_updates_from,
+        /root/reference/src/antidote_dc_manager.erl:83-87).  Learns the
+        remote endpoint, opens the stream subscription; the opid-gap
+        catch-up machinery fetches anything missed before connecting."""
+        if isinstance(desc, dict):
+            desc = Descriptor.from_wire(desc)
+        remote_fid = desc.fabric_id if desc.fabric_id is not None else desc.dc_id
+        if remote_fid == self.fabric_id:
+            return  # self-descriptor: nothing to subscribe to
+        if desc.address is not None:
+            connect = getattr(self.hub, "connect_remote", None)
+            if connect is not None:
+                connect(remote_fid, desc.address[0], int(desc.address[1]))
+        self.hub.subscribe(self.fabric_id, remote_fid, self._on_message)
 
     @staticmethod
     def connect_all(replicas: List["DCReplica"]) -> None:
@@ -270,12 +301,22 @@ class DCReplica:
 
     def safe_time(self, shard: int) -> int:
         """Largest own-lane ts such that no future local commit on
-        ``shard`` can carry a smaller one.  Single-node DCs mint commits
+        ``shard`` can carry a smaller one — AND every commit at or below
+        it has already been published to the stream (taken under the
+        manager's commit lock: a counter read mid-commit would mint a
+        ping that outruns its own txn on the wire, and the subscriber's
+        chain-clock duplicate suppression would drop the txn as
+        already-applied).  Single-node DCs mint commits
         from one monotone counter applied synchronously, so the counter
         itself is safe for every shard.  Cluster members override this
         (their safe time is the sequencer frontier, gated on outstanding
         prepared txns)."""
-        return self.node.txm.commit_counter
+        txm = self.node.txm
+        lock = getattr(txm, "commit_lock", None)
+        if lock is None:
+            return txm.commit_counter
+        with lock:
+            return txm.commit_counter
 
     def heartbeat(self, exclude=frozenset()) -> None:
         """Broadcast per-shard safe times (the reference's per-partition

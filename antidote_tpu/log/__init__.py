@@ -49,6 +49,36 @@ def load_dir_meta(directory: str) -> Optional[dict]:
         ) from e
 
 
+def _set_dir_meta_key(directory: str, key: str, value) -> None:
+    """Atomically (write-temp + fsync + rename) set one key in a log
+    dir's metadata file."""
+    path = os.path.join(directory, _META_FILE)
+    meta = load_dir_meta(directory) or {}
+    meta[key] = value
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def mark_dir_retired(directory: str, by_epoch: int) -> None:
+    """Stamp a log dir as superseded by a membership-layout change.
+
+    Offline resize moves every shard's data into the NEW layout's dirs;
+    an old-dir member booted afterwards would serve (and extend!) a
+    stale copy of shards that now have different owners — a split-brain
+    the riak_core ring epoch prevents in the reference.  Retired dirs
+    refuse to boot until an operator consciously clears the stamp."""
+    _set_dir_meta_key(directory, "retired_by_layout_epoch", int(by_epoch))
+
+
+def stamp_layout_epoch(directory: str, epoch: int) -> None:
+    """Record the membership-layout epoch a dir belongs to."""
+    _set_dir_meta_key(directory, "layout_epoch", int(epoch))
+
+
 def _validate_dir(cfg: AntidoteConfig, directory: str) -> None:
     """First boot stamps the deployment shape into the log directory;
     every later boot validates it.  Booting a WAL directory with a
@@ -58,6 +88,16 @@ def _validate_dir(cfg: AntidoteConfig, directory: str) -> None:
     reference against the same operator error (r1 advisor medium (a))."""
     meta = load_dir_meta(directory)
     if meta is not None:
+        retired = meta.get("retired_by_layout_epoch")
+        if retired is not None:
+            raise LogDirMismatch(
+                f"log dir {directory!r} was retired by membership-layout "
+                f"epoch {retired} (its shards moved to the new layout's "
+                "dirs at resize); booting it would serve and extend a "
+                "stale pre-resize copy.  If this is intentional "
+                "(restoring a backup), delete the "
+                "'retired_by_layout_epoch' key from antidote_meta.json."
+            )
         if (meta["n_shards"] != cfg.n_shards
                 or meta["max_dcs"] != cfg.max_dcs):
             raise LogDirMismatch(

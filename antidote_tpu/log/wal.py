@@ -19,8 +19,7 @@ import struct
 import subprocess
 import zlib
 from pathlib import Path
-from typing import Callable, Iterator, Optional
-
+from typing import Iterator
 import msgpack
 
 _MAGIC = 0xA17D07E1

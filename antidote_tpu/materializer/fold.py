@@ -24,7 +24,6 @@ construction (see store/typed_table.py).
 
 from __future__ import annotations
 
-from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +57,9 @@ def fold_key(ty, cfg, state0, ops_a, ops_b, ops_vc, ops_origin, n_ops, base_vc, 
         step,
         (state0, jnp.int32(0)),
         (ops_a, ops_b, ops_vc, ops_origin, jnp.arange(k, dtype=jnp.int32)),
+        # short rings (the kmax-sliced serve path) unroll fully: XLA then
+        # fuses the steps into one kernel instead of a per-step loop
+        unroll=k if k <= 8 else 1,
     )
     return state, applied
 

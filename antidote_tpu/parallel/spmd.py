@@ -20,7 +20,6 @@ per step — replacing the gossip rounds entirely.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

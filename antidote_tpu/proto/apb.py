@@ -122,6 +122,11 @@ SCHEMAS: Dict[str, list] = {
                                (2, "updates", "repeated", "ApbUpdateOp")],
     "ApbStaticReadObjects": [(1, "transaction", "required", "ApbStartTransaction"),
                              (2, "objects", "repeated", "ApbBoundObject")],
+    "ApbCreateDC": [(1, "nodes", "repeated", "bytes")],
+    "ApbConnectToDCs": [(1, "descriptors", "repeated", "bytes")],
+    "ApbGetConnectionDescriptor": [],
+    "ApbGetConnectionDescriptorResp": [(1, "success", "required", "bool"),
+                                       (2, "descriptor", "optional", "bytes")],
     "ApbStartTransactionResp": [(1, "success", "required", "bool"),
                                 (2, "transaction_descriptor", "optional", "bytes"),
                                 (3, "errorcode", "optional", "uint32")],
@@ -168,12 +173,22 @@ MSG_CODES: Dict[str, int] = {
     "ApbReadObjectsResp": 126,
     "ApbCommitResp": 127,
     "ApbStaticReadObjectsResp": 128,
+    # DC management (antidote_pb_process:process create_dc /
+    # get_connection_descriptor / connect_to_dcs clauses,
+    # /root/reference/src/antidote_pb_process.erl:103-135); the
+    # descriptor payload is an opaque blob to clients in the reference
+    # too (term_to_binary there, msgpack here)
+    "ApbCreateDC": 129,
+    "ApbConnectToDCs": 130,
+    "ApbGetConnectionDescriptor": 131,
+    "ApbGetConnectionDescriptorResp": 132,
 }
 CODE_TO_NAME = {v: k for k, v in MSG_CODES.items()}
 
 #: request codes the server dispatches to this codec (the antidotec_pb
 #: client surface); disjoint from the native msgpack codec's codes 1-11
-APB_REQUEST_CODES = frozenset((116, 118, 119, 120, 121, 122, 123))
+APB_REQUEST_CODES = frozenset((116, 118, 119, 120, 121, 122, 123,
+                               129, 130, 131))
 
 #: antidote.proto CRDT_type enum <-> our type registry names
 CRDT_TYPES = {
@@ -528,6 +543,25 @@ def _dispatch(server, name: str, req: Dict[str, Any],
             conn_txns.discard(txid)
             if txn is not None:
                 node.abort_transaction(txn)
+            return "ApbOperationResp", {"success": True}
+        if name == "ApbGetConnectionDescriptor":
+            import msgpack
+
+            return "ApbGetConnectionDescriptorResp", {
+                "success": True,
+                "descriptor": msgpack.packb(server._get_descriptor()),
+            }
+        if name == "ApbConnectToDCs":
+            import msgpack
+
+            server._connect_to_dcs(
+                [msgpack.unpackb(b, raw=False)
+                 for b in req.get("descriptors", [])]
+            )
+            return "ApbOperationResp", {"success": True}
+        if name == "ApbCreateDC":
+            server._create_dc([b.decode() if isinstance(b, bytes) else b
+                               for b in req.get("nodes", [])])
             return "ApbOperationResp", {"success": True}
         return "ApbErrorResp", {
             "errmsg": to_bytes(f"unhandled apb request {name}"), "errcode": 0,

@@ -101,6 +101,15 @@ class AntidoteClient:
         return self._call(MessageCode.GET_CONNECTION_DESCRIPTOR,
                           {})["descriptor"]
 
+    def connect_to_dcs(self, descriptors) -> None:
+        """Subscribe this node's DC to remote DCs' txn streams
+        (antidote_dc_manager:subscribe_updates_from)."""
+        self._call(MessageCode.CONNECT_TO_DCS,
+                   {"descriptors": list(descriptors)})
+
+    def create_dc(self, nodes) -> None:
+        self._call(MessageCode.CREATE_DC, {"nodes": list(nodes)})
+
     def node_status(self, include_ready: bool = False) -> dict:
         """Operator snapshot (console `status`; no reference pb
         equivalent — the reference exposes this via riak-admin/console).

@@ -273,6 +273,7 @@ class ProtocolServer:
                         self._run_update_group(ups)
                     if reads:
                         self._run_read_group(reads)
+                    self._maybe_publish_epochs()
             except BaseException as e:  # never strand a parked connection
                 for w in works:
                     if not w.event.is_set():
@@ -291,29 +292,84 @@ class ProtocolServer:
                         w.error = ConnectionError("server shutting down")
                         w.event.set()
 
+    #: serving-epoch publication cadence (seconds): each tick freezes the
+    #: tables' heads so reads pinned at/below that snapshot stay pure
+    #: gathers while writes advance (the read-while-write double buffer —
+    #: without a production publisher the epoch machinery would only ever
+    #: run in benchmarks)
+    EPOCH_PUBLISH_S = 2.0
+    _last_epoch_pub = 0.0
+    _epoch_pub_mutations = -1
+
+    def _maybe_publish_epochs(self) -> None:
+        txm = getattr(self.node, "txm", None)
+        if txm is None:
+            return  # cluster members publish at their own stores
+        import time as _t
+
+        now = _t.monotonic()
+        if now - self._last_epoch_pub < self.EPOCH_PUBLISH_S:
+            return
+        store = txm.store
+        if store.mutation_epoch == self._epoch_pub_mutations:
+            return  # nothing new committed since the last freeze
+        self._last_epoch_pub = now
+        self._epoch_pub_mutations = store.mutation_epoch
+        for t in store.tables.values():
+            t.publish_epoch()
+
     def _run_read_group(self, works: List[_StaticWork]) -> None:
-        clock = None
+        # requests whose causal clock is already covered locally merge
+        # into ONE snapshot read; a clock AHEAD of local replication (or
+        # bogus) must WAIT inside start_transaction — running it solo
+        # keeps one slow client from head-of-line-blocking the batch
+        covered = self._covered_vc()
+        merged, solo = [], []
         for w in works:
-            if w.clock is not None:
-                clock = w.clock if clock is None else np.maximum(clock, w.clock)
-        objs: list = []
-        offs = [0]
-        for w in works:
-            objs.extend(w.objects)
-            offs.append(len(objs))
-        try:
-            vals, vc = self.node.read_objects(objs, clock=clock)
-            for i, w in enumerate(works):
-                w.result = (vals[offs[i]:offs[i + 1]], vc)
-                w.event.set()
-        except Exception:
-            # isolate the offending request: replay each alone
-            for w in works:
-                try:
-                    w.result = self.node.read_objects(w.objects, clock=w.clock)
-                except Exception as e:
-                    w.error = e
-                w.event.set()
+            if w.clock is None or (covered is not None
+                                   and (w.clock <= covered).all()):
+                merged.append(w)
+            else:
+                solo.append(w)
+        if merged:
+            clock = None
+            for w in merged:
+                if w.clock is not None:
+                    clock = (w.clock if clock is None
+                             else np.maximum(clock, w.clock))
+            objs: list = []
+            offs = [0]
+            for w in merged:
+                objs.extend(w.objects)
+                offs.append(len(objs))
+            try:
+                vals, vc = self.node.read_objects(objs, clock=clock)
+                for i, w in enumerate(merged):
+                    w.result = (vals[offs[i]:offs[i + 1]], vc)
+                    w.event.set()
+            except Exception:
+                solo = merged + solo  # isolate the offender
+        for w in solo:
+            if w.event.is_set():
+                continue
+            try:
+                w.result = self.node.read_objects(w.objects, clock=w.clock)
+            except Exception as e:
+                w.error = e
+            w.event.set()
+
+    def _covered_vc(self):
+        """Freshest locally-covered clock (entry-wise), or None when the
+        node doesn't expose one (then every clocked read runs solo)."""
+        txm = getattr(self.node, "txm", None)
+        if txm is not None:
+            vc = txm.store.dc_max_vc().copy()
+            vc[txm.my_dc] = max(int(vc[txm.my_dc]), txm.commit_counter)
+            return vc
+        member = getattr(self.node, "member", None)
+        if member is not None:
+            return np.asarray(member.stable_vc())
+        return None
 
     def _run_update_group(self, works: List[_StaticWork]) -> None:
         txm = getattr(self.node, "txm", None)
@@ -427,14 +483,15 @@ class ProtocolServer:
             node.abort_transaction(txn)
             return MessageCode.OPERATION_RESP, {"ok": True}
         if code == MessageCode.GET_CONNECTION_DESCRIPTOR:
-            if self.interdc is None:
-                raise RuntimeError("no inter-DC replica attached")
-            d = self.interdc.descriptor()
             return MessageCode.OPERATION_RESP, {
-                "descriptor": {"dc_id": d.dc_id, "name": d.name,
-                               "n_shards": d.n_shards,
-                               "address": d.address},
+                "descriptor": self._get_descriptor(),
             }
+        if code == MessageCode.CONNECT_TO_DCS:
+            self._connect_to_dcs(body.get("descriptors", []))
+            return MessageCode.OPERATION_RESP, {"ok": True}
+        if code == MessageCode.CREATE_DC:
+            self._create_dc(body.get("nodes", []))
+            return MessageCode.OPERATION_RESP, {"ok": True}
         if code == MessageCode.NODE_STATUS:
             return MessageCode.OPERATION_RESP, {
                 "status": node.status(
@@ -448,6 +505,35 @@ class ProtocolServer:
         if txn is None:
             raise KeyError(f"unknown or finished transaction {txid}")
         return txn
+
+    # ------------------------------------------------------------------
+    # DC management (antidote_pb_process:process create_dc /
+    # get_connection_descriptor / connect_to_dcs clauses,
+    # /root/reference/src/antidote_pb_process.erl:103-135) — shared by
+    # both wire dialects
+    # ------------------------------------------------------------------
+    def _get_descriptor(self) -> dict:
+        if self.interdc is None:
+            raise RuntimeError("no inter-DC replica attached")
+        return self.interdc.descriptor().to_wire()
+
+    def _connect_to_dcs(self, descriptors) -> None:
+        if self.interdc is None:
+            raise RuntimeError("no inter-DC replica attached")
+        for d in descriptors:
+            self.interdc.observe_descriptor(d)
+
+    def _create_dc(self, nodes) -> None:
+        """The reference assembles a riak cluster from ``nodes`` here;
+        this build's DC is assembled at boot (console serve /
+        cluster.boot ctl_wire), so a single-node list is acknowledged
+        (the DC exists) and a multi-node list is refused with the
+        operator path, matching create_dc's error reply shape."""
+        if len(nodes) > 1:
+            raise RuntimeError(
+                "create_dc_failed: multi-member DCs assemble via "
+                "cluster.boot + ctl_wire, not the client protocol"
+            )
 
     # ------------------------------------------------------------------
     def is_alive(self) -> bool:

@@ -243,10 +243,14 @@ class KVStore:
         import threading as _threading
 
         self._value_cache_lock = _threading.Lock()
-        #: bumped once per apply_effects batch; fills racing a concurrent
-        #: commit are dropped (the entry could otherwise claim a fill
-        #: clock that already covers the commit it never saw)
+        #: bumped at BOTH ends of every apply_effects batch (with
+        #: ``_mutating`` covering the window between): fills racing a
+        #: concurrent commit are dropped whether they captured their
+        #: epoch before the apply, or mid-apply — either could otherwise
+        #: cache a pre-apply value whose fill clock claims coverage of
+        #: the commit it never saw
         self.mutation_epoch = 0
+        self._mutating = False
         #: (src_tname, dst_tname) -> jitted one-launch row promotion —
         #: ~25 eager device ops per promotion otherwise, each a dispatch
         #: (and on first use a compile), which made every hot-key tier
@@ -335,6 +339,15 @@ class KVStore:
         (the batched analogue of clocksi_vnode:update_materializer,
         /root/reference/src/clocksi_vnode.erl:634-657).
         """
+        self._mutating = True
+        self.mutation_epoch += 1
+        try:
+            self._apply_effects_inner(effects, commit_vcs, origins)
+        finally:
+            self.mutation_epoch += 1
+            self._mutating = False
+
+    def _apply_effects_inner(self, effects, commit_vcs, origins) -> None:
         self.locate_many([(e.key, e.type_name, e.bucket) for e in effects])
         # ---- overflow escape hatch: promote BEFORE anything can drop.
         # Aggregate each key's worst-case fresh-slot demand (+ the minimum
@@ -416,7 +429,6 @@ class KVStore:
         # ops — the causal gate trusts it)
         for shard, vc in touched:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
-        self.mutation_epoch += 1
 
     # ------------------------------------------------------------------
     # decoded-value cache (serving hot path)
@@ -470,7 +482,7 @@ class KVStore:
         the mutation epoch at the same point — a concurrent commit in
         between drops the fill instead of caching a value that claims
         coverage it does not have."""
-        if epoch != self.mutation_epoch:
+        if epoch != self.mutation_epoch or self._mutating:
             return
         # own a copy: the caller's value is handed to the client, who may
         # mutate it

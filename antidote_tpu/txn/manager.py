@@ -34,7 +34,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from antidote_tpu.clock import vector as vcm
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import TYPES, get_type, is_type
 from antidote_tpu.store.kv import BoundObject, Effect, KVStore
@@ -107,6 +106,16 @@ class TransactionManager:
         assert protocol in ("clocksi", "gr"), protocol
         self.protocol = protocol
         self.commit_counter = 0
+        #: held across counter increment → apply → publish listeners, and
+        #: taken by anything deriving a SAFE time from the counter (the
+        #: inter-DC heartbeat): a ping minted from a mid-commit counter
+        #: would claim a ts whose txn has not reached the wire yet, and
+        #: the subscriber's chain-clock duplicate suppression would then
+        #: drop the real txn as already-applied.  Reentrant: commit
+        #: listeners themselves trigger heartbeats.
+        import threading as _threading
+
+        self.commit_lock = _threading.RLock()
         #: (key, bucket) -> my-lane counter of its last local commit.
         #: Bounded: entries at or below every open txn's snapshot can
         #: never conflict again and are GC'd periodically (the reference
@@ -442,6 +451,10 @@ class TransactionManager:
         certify prop mirrors the reference's txn_props certify flag
         (/root/reference/src/clocksi_interactive_coord.erl
         get_txn_property)."""
+        with self.commit_lock:
+            return self._commit_group_locked(txns)
+
+    def _commit_group_locked(self, txns: Sequence[Transaction]):
         out: List[Any] = []
         pend: List[tuple] = []  # (txn, commit_vc, effects)
         for txn in txns:

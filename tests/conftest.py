@@ -26,6 +26,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 from antidote_tpu.config import enable_compilation_cache  # noqa: E402
 
+# own cache namespace: the 8-virtual-device test config compiles with
+# different machine-feature flags than 1-device server processes, and
+# cross-loading the other config's AOT entries spams feature-mismatch
+# warnings on every load
+os.environ.setdefault(
+    "ANTIDOTE_XLA_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "antidote_tpu_xla_t8"),
+)
 enable_compilation_cache()
 
 import pytest  # noqa: E402

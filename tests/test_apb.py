@@ -25,6 +25,8 @@ from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.proto import apb
 from antidote_tpu.proto.server import ProtocolServer
 
+pytestmark = pytest.mark.smoke
+
 ANTIDOTE_PROTO = r"""
 syntax = "proto2";
 enum CRDT_type {

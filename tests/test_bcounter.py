@@ -3,13 +3,11 @@
 queued transfer requests from richer DCs, grace-period throttling, and the
 granter side committing transfer updates that replicate back."""
 
-import numpy as np
 import pytest
 
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.interdc import DCReplica, LoopbackHub
-from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
 from antidote_tpu.txn.manager import AbortError
 
 

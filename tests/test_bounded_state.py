@@ -8,7 +8,6 @@ restore_from_log grouping txns by (origin, vc) identity rather than
 record adjacency (r1 advisor medium (c)).
 """
 
-import numpy as np
 import pytest
 
 from antidote_tpu.api.node import AntidoteNode

@@ -3,6 +3,9 @@ import numpy as np
 
 from antidote_tpu.clock import vector as vc
 from antidote_tpu.clock import orddict
+import pytest
+
+pytestmark = pytest.mark.smoke
 
 
 def c(*xs):

@@ -12,7 +12,6 @@ connections interleave, in both wire dialects.
 import struct
 import threading
 
-import pytest
 
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.config import AntidoteConfig

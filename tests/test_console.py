@@ -3,13 +3,13 @@
 /root/reference/src/wait_init.erl:50-88)."""
 
 import json
-import threading
 
 import pytest
 
 from antidote_tpu.api import AntidoteNode
-from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.proto.server import ProtocolServer
+
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture

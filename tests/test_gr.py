@@ -3,8 +3,6 @@
 scalar global-stable-time points; remote writes become visible only once
 every lane's clock passed their timestamp."""
 
-import numpy as np
-import pytest
 
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig

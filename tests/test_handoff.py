@@ -8,7 +8,7 @@ import pytest
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.store import handoff
-from antidote_tpu.store.kv import KVStore, key_to_shard
+from antidote_tpu.store.kv import key_to_shard
 
 
 def mk_cfg(n_shards=4):

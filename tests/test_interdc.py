@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from antidote_tpu.api import AntidoteNode
-from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.interdc import DCReplica, LoopbackHub
 
 

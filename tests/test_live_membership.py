@@ -1,0 +1,184 @@
+"""Live membership change under load (r4 VERDICT item 5).
+
+The reference joins/leaves nodes while serving (riak_core staged
+join/leave + ownership handoff, antidote_dc_manager:create_dc /
+antidote_console); here shards stream between members one at a time
+while coordinators keep committing — the test drives continuous writes
+THROUGH the whole join and asserts zero lost/duplicated ops.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from antidote_tpu.cluster.coordinator import ClusterNode
+from antidote_tpu.cluster.join import live_join, live_leave, plan_moves
+from antidote_tpu.cluster.member import ClusterMember, owned_shards
+from antidote_tpu.config import AntidoteConfig
+
+
+@pytest.fixture
+def cfg():
+    return AntidoteConfig(n_shards=8, max_dcs=2, ops_per_key=8,
+                          snap_versions=2, set_slots=8, keys_per_table=64,
+                          batch_buckets=(8, 64))
+
+
+def _wire(members):
+    for i, m in enumerate(members):
+        for j, o in enumerate(members):
+            if i != j and j not in m.peers:
+                m.connect(j, *o.address)
+
+
+def _rpcs(members):
+    return {m.member_id: tuple(m.address) for m in members}
+
+
+def test_live_join_under_load_then_leave(cfg):
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=2)
+          for i in range(2)]
+    _wire(ms)
+    live = list(ms)
+    try:
+        nodes = [ClusterNode(m) for m in ms]
+        n_keys = 24
+        acked = np.zeros(n_keys, np.int64)
+        acked_lock = threading.Lock()
+        stop = threading.Event()
+        errs = []
+
+        def writer(node, seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                k = int(rng.integers(n_keys))
+                try:
+                    node.update_objects(
+                        [(k, "counter_pn", "b", ("increment", 1))])
+                except Exception as e:
+                    if "abort" in str(e).lower():
+                        continue  # cert conflict: not acked, retryable
+                    import traceback
+                    errs.append(traceback.format_exc())
+                    return
+                with acked_lock:
+                    acked[k] += 1
+
+        ts = [threading.Thread(target=writer, args=(nodes[i % 2], 40 + i))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        time.sleep(1.0)  # load running against the 2-member cluster
+
+        # ---- live join member 2, WHILE the writers run
+        joiner = ClusterMember(cfg, dc_id=0, member_id=2, n_members=3,
+                               shards=[])
+        live.append(joiner)
+        _wire(live)
+        moved = live_join(_rpcs(live), new_id=2)
+        assert moved == len(plan_moves(
+            {s: s % 2 for s in range(cfg.n_shards)}, 3))
+        assert joiner.shards == set(owned_shards(cfg, 2, 3))
+
+        time.sleep(1.0)  # load continues on the 3-member cluster
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+
+        # every member agrees on the modular 3-member map
+        for m in live:
+            assert m.shard_map == {s: s % 3 for s in range(cfg.n_shards)}
+        assert {s for m in live for s in m.shards} == set(range(cfg.n_shards))
+
+        # zero lost, zero duplicated: every acked increment is readable
+        # exactly once, from every member's coordinator
+        objs = [(k, "counter_pn", "b") for k in range(n_keys)]
+        for node in (ClusterNode(joiner), nodes[0], nodes[1]):
+            vals, _ = node.read_objects(objs)
+            got = np.asarray(vals, np.int64)
+            assert (got == acked).all(), (got.tolist(), acked.tolist())
+
+        # ---- live leave: member 2 drains back out, data survives
+        live_leave(_rpcs(live), leaving_id=2)
+        assert joiner.shards == set()
+        vals, _ = nodes[0].read_objects(objs)
+        assert (np.asarray(vals, np.int64) == acked).all()
+        for m in ms:
+            assert m.shard_map == {s: s % 2 for s in range(cfg.n_shards)}
+        # the shrunk cluster still commits
+        nodes[1].update_objects([(0, "counter_pn", "b", ("increment", 5))])
+        vals, _ = nodes[0].read_objects([(0, "counter_pn", "b")])
+        assert vals[0] == int(acked[0]) + 5
+    finally:
+        for m in live:
+            try:
+                m.close()
+            except Exception:
+                pass
+
+
+def test_join_recovers_from_crash_mid_move(cfg, tmp_path):
+    """A member crashing after exporting (but before the import lands)
+    recovers with the moved layout from its prepare log; the driver's
+    retained package completes the move."""
+    from antidote_tpu.store import handoff as _handoff
+
+    dirs = [str(tmp_path / f"m{i}") for i in range(2)]
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=2,
+                        log_dir=dirs[i]) for i in range(2)]
+    _wire(ms)
+    joiner_dir = str(tmp_path / "m2")
+    try:
+        node = ClusterNode(ms[0])
+        for k in range(12):
+            node.update_objects([(k, "counter_pn", "b", ("increment", k + 1))])
+        joiner = ClusterMember(cfg, dc_id=0, member_id=2, n_members=3,
+                               shards=[], log_dir=joiner_dir)
+        ms.append(joiner)
+        _wire(ms)
+        for m in ms:
+            m.m_join_begin(2, list(joiner.address), 3)
+        # move ONE shard by hand, crashing before the import: the
+        # exporter has durably relinquished; the package completes later
+        moves = plan_moves({s: int(o) for s, o in
+                            ms[0].m_shard_map().items()}, 3)
+        shard, src, dst = moves[0]
+        data = ms[src].m_export_shard(shard, dst)
+        assert shard not in ms[src].shards
+        # "crash" the exporter and recover it from its log dir
+        ms[src].close()
+        ms[src].node.store.log.close()
+        ms[src]._prep_wal.close()
+        rec = ClusterMember(cfg, dc_id=0, member_id=src, n_members=3,
+                            log_dir=dirs[src], recover=True)
+        ms[src] = rec
+        # rejoin re-wiring: peers must learn the recovered member's NEW
+        # address (the takeover rejoin flow's re-ctl_wire step)
+        for m in ms:
+            if m is not rec:
+                m.connect(src, *rec.address)
+        _wire(ms)
+        assert shard not in rec.shards  # the own-event replayed
+        assert rec.shard_map[shard] == dst
+        # driver completes the interrupted move + the rest of the plan
+        ms[dst].m_import_shard(data)
+        for shard2, src2, dst2 in moves[1:]:
+            d2 = ms[src2].m_export_shard(shard2, dst2)
+            ms[dst2].m_import_shard(d2)
+            for m in ms:
+                if m.member_id not in (src2, dst2):
+                    m.m_set_owner(shard2, dst2, 3)
+        for m in ms:
+            m.m_set_owner(shard, dst, 3)
+        vals, _ = ClusterNode(ms[1]).read_objects(
+            [(k, "counter_pn", "b") for k in range(12)])
+        assert vals == [k + 1 for k in range(12)]
+    finally:
+        for m in ms:
+            try:
+                m.close()
+            except Exception:
+                pass

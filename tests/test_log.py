@@ -12,6 +12,8 @@ import pytest
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.log.wal import ShardWAL, replay, _load_lib
 
+pytestmark = pytest.mark.smoke
+
 
 def test_wal_native_build():
     assert _load_lib() is not None, "C++ WAL must compile with g++"

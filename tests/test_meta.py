@@ -4,11 +4,13 @@ stable_meta_data_server + dc_meta_data_utilities (SURVEY §2.6)."""
 
 import os
 
-import pytest
 
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.meta import MetaCluster, MetaDataStore
+import pytest
+
+pytestmark = pytest.mark.smoke
 
 
 def test_local_put_get_and_persistence(tmp_path):

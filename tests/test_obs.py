@@ -12,7 +12,6 @@ from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.obs import (
     Histogram,
-    MetricsServer,
     NodeMetrics,
     Timer,
     install_error_monitor,
@@ -25,6 +24,8 @@ def small_cfg():
         n_shards=2, max_dcs=2, ops_per_key=4, snap_versions=2,
         set_slots=4, keys_per_table=16, batch_buckets=(8,),
     )
+
+pytestmark = pytest.mark.smoke
 
 
 def test_txn_metrics_wiring():

@@ -9,7 +9,6 @@ never truncate.  Done-criterion from the VERDICT: write 10x
 """
 
 import numpy as np
-import pytest
 
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.config import AntidoteConfig

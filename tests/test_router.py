@@ -7,6 +7,8 @@ import pytest
 
 from antidote_tpu.store import router
 
+pytestmark = pytest.mark.smoke
+
 
 def test_xxh64_known_vectors_python():
     # published XXH64 reference vectors (seed 0)

@@ -1,9 +1,7 @@
 """Sharded SPMD step on a virtual 8-device CPU mesh."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import get_type

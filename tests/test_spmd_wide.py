@@ -7,8 +7,6 @@ multi-device analogues of the reference's multidc CT suites
 
 import jax
 import numpy as np
-import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig

@@ -2,10 +2,12 @@
 /root/reference/src/antidote_sup.erl:137): dead children restart in
 place; exceeding the restart intensity shuts the tree down."""
 
-import threading
 import time
 
 from antidote_tpu.supervise import Supervisor
+import pytest
+
+pytestmark = pytest.mark.smoke
 
 
 class FakeService:

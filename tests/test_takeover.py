@@ -13,7 +13,6 @@ In-process tier; the 4-OS-process kill -9 cases live in
 test_cluster_processes.py.
 """
 
-import numpy as np
 import pytest
 
 from antidote_tpu.cluster import ClusterMember, ClusterNode

@@ -2,10 +2,11 @@
 (clocksi_SUITE read-your-writes/isolation/concurrency, antidote_SUITE
 static+interactive API, commit_hooks_SUITE; SURVEY §4 tier-3)."""
 
-import numpy as np
 import pytest
 
 from antidote_tpu.api import AbortError, AntidoteNode
+
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture

@@ -7,7 +7,6 @@ incomplete-read detection.
 """
 
 import numpy as np
-import pytest
 
 from antidote_tpu.crdt import get_type
 from antidote_tpu.crdt.blob import BlobStore

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Stdlib static-analysis gate (no third-party linter ships in this
+image; ruff/mypy configs in pyproject.toml cover richer CI hosts).
+
+Checks (each one has caught a real bug class in this codebase's history):
+  * syntax: every file must compile (the round-4 advisor patch cycle
+    shipped an IndentationError mid-session);
+  * unused imports (module scope);
+  * duplicate top-level / class-level function definitions (a paste slip
+    silently shadows the first definition);
+  * mutable default arguments;
+  * bare ``except:`` (swallows KeyboardInterrupt/SystemExit).
+
+Usage: python tools/lint.py [paths...]   (default: antidote_tpu tests
+bench.py bench_suite.py bench_wire.py tpu_smoke.py __graft_entry__.py)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+
+def iter_py(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in files:
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def used_names(tree: ast.AST):
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # names listed in __all__ (and doctest-ish strings) count as
+            # used — re-export surfaces are intentional
+            if node.value.isidentifier():
+                names.add(node.value)
+    return names
+
+
+def check_file(path: str):
+    problems = []
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    used = used_names(tree)
+    # "# noqa" on the import line opts out (re-export modules etc.)
+    lines = src.splitlines()
+
+    def noqa(lineno: int) -> bool:
+        return "noqa" in lines[lineno - 1]
+
+    is_init = os.path.basename(path) == "__init__.py"
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if is_init:
+                continue  # package __init__: re-export surface
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = (alias.asname or alias.name).split(".")[0]
+                if bound not in used and not noqa(node.lineno):
+                    problems.append(
+                        f"{path}:{node.lineno}: unused import '{bound}'"
+                    )
+    for scope in ast.walk(tree):
+        if isinstance(scope, (ast.Module, ast.ClassDef)):
+            seen = {}
+            body = scope.body
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name in seen and not noqa(node.lineno):
+                        problems.append(
+                            f"{path}:{node.lineno}: duplicate definition "
+                            f"of '{node.name}' (first at line "
+                            f"{seen[node.name]})"
+                        )
+                    seen[node.name] = node.lineno
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{d.lineno}: mutable default argument in "
+                        f"'{node.name}'"
+                    )
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None and not noqa(node.lineno):
+                problems.append(f"{path}:{node.lineno}: bare 'except:'")
+    return problems
+
+
+def main(argv):
+    paths = argv[1:] or ["antidote_tpu", "tests", "bench.py",
+                         "bench_suite.py", "bench_wire.py", "tpu_smoke.py",
+                         "__graft_entry__.py", "tools"]
+    all_problems = []
+    n = 0
+    for path in iter_py(paths):
+        n += 1
+        all_problems.extend(check_file(path))
+    for p in all_problems:
+        print(p)
+    print(f"lint: {n} files, {len(all_problems)} problem(s)",
+          file=sys.stderr)
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
