@@ -89,9 +89,16 @@ def live_join(rpcs: Dict[int, Tuple[str, int]], new_id: int) -> int:
     """Join member ``new_id`` (already booted empty and wired) into a
     serving cluster.  ``rpcs``: member_id -> RPC address for EVERY
     member including the joiner.  Returns the number of shards moved."""
+    n_new = max(rpcs) + 1
+    if sorted(rpcs) != list(range(n_new)) or new_id != n_new - 1:
+        # fail BEFORE the durable members broadcast: a gapped id would
+        # half-commit a count whose modular layout names a member that
+        # will never exist
+        raise ValueError(
+            f"member ids must be contiguous 0..{n_new - 1} with the "
+            f"joiner last (got {sorted(rpcs)}, joiner {new_id})")
     clients = {m: RpcClient(*a) for m, a in rpcs.items()}
     try:
-        n_new = max(rpcs) + 1
         for m, c in clients.items():
             c.call("m_join_begin", new_id, list(rpcs[new_id]), n_new)
         cur = {int(s): int(o)
@@ -113,6 +120,10 @@ def live_leave(rpcs: Dict[int, Tuple[str, int]], leaving_id: int) -> int:
             "live leave drains the highest member id (leaving an "
             "arbitrary id renumbers the modular layout — use the "
             "offline resize tool for that)")
+    if sorted(rpcs) != list(range(leaving_id + 1)):
+        raise ValueError(
+            f"member ids must be contiguous 0..{leaving_id} "
+            f"(got {sorted(rpcs)})")
     clients = {m: RpcClient(*a) for m, a in rpcs.items()}
     try:
         n_new = leaving_id
@@ -123,7 +134,9 @@ def live_leave(rpcs: Dict[int, Tuple[str, int]], leaving_id: int) -> int:
             _move_shard(clients, shard, src, dst, n_new)
         for m, c in clients.items():
             if m != leaving_id:
-                c.call("m_join_begin", leaving_id, ["", 0], n_new)
+                # drop the departed peer everywhere (its client closes;
+                # gossip rows go with it) and shrink the count durably
+                c.call("m_forget_member", leaving_id, n_new)
         return len(moves)
     finally:
         for c in clients.values():

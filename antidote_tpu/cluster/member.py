@@ -281,7 +281,8 @@ class ClusterMember:
                      "m_block_txn", "m_forget_txn", "m_resolve_chain",
                      "m_txn_sequenced", "m_resolve_stale_txn",
                      "m_process_transfer", "m_shard_map", "m_join_begin",
-                     "m_export_shard", "m_import_shard", "m_set_owner"):
+                     "m_export_shard", "m_import_shard", "m_set_owner",
+                     "m_forget_member"):
             self.rpc.register(name, getattr(self, name))
 
     def coordinator(self):
@@ -722,6 +723,11 @@ class ClusterMember:
         deadline = _t.monotonic() + timeout
         while True:
             self.advance_idle_shards()
+            if shard not in self.shards:
+                # a live move took the shard mid-wait: its frozen local
+                # clock would never reach want_ts — surface the
+                # RETRYABLE ownership error, not a 30s timeout
+                self._check_owner(shard)
             if int(self.node.store.applied_vc[shard, self.dc_id]) >= want_ts:
                 return
             if _t.monotonic() > deadline:
@@ -1337,8 +1343,14 @@ class ClusterMember:
     # stable-time aggregation (meta_data_sender stable-time gossip)
     # ------------------------------------------------------------------
     def refresh_peer_clocks(self) -> None:
-        for mid, cli in self.peers.items():
-            rows = cli.call("m_clocks")
+        for mid, cli in list(self.peers.items()):
+            try:
+                rows = cli.call("m_clocks")
+            except Exception:
+                # unreachable peer (crashed, or departed via live leave):
+                # keep its last gossiped rows; staleness is safe (mins
+                # only lag) and takeover/rewire handles the rest
+                continue
             mat = self.peer_clocks.get(mid)
             if mat is None:
                 mat = np.zeros((self.cfg.n_shards, self.cfg.max_dcs),
@@ -1346,6 +1358,23 @@ class ClusterMember:
                 self.peer_clocks[mid] = mat
             for s, row in rows:
                 np.maximum(mat[s], np.asarray(row, np.int32), out=mat[s])
+
+    def m_forget_member(self, member_id: int, n_members_new: int) -> bool:
+        """Drop a departed member (live leave): close + remove its peer
+        client and gossip rows, shrink the member count."""
+        with self._lock:
+            member_id = int(member_id)
+            self.n_members = int(n_members_new)
+            cli = self.peers.pop(member_id, None)
+            if cli is not None:
+                try:
+                    cli.close()
+                except Exception:
+                    pass
+            self.peer_clocks.pop(member_id, None)
+            self._prep_append({"ev": "members", "txid": 0,
+                               "n": int(n_members_new)})
+        return True
 
     def clock_matrix(self) -> np.ndarray:
         """The DC's full (shards x D) applied matrix: my owned rows live,

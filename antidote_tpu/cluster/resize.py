@@ -124,8 +124,7 @@ def resize_dc(old_dirs: List[str], new_dirs: List[str], dc_id: int = 0
     # epoch into the new dirs and RETIRE the old ones — an old-dir member
     # booted after the resize would serve (and extend) a stale copy of
     # shards that now live elsewhere
-    from antidote_tpu.log import load_dir_meta, mark_dir_retired, \
-        stamp_layout_epoch
+    from antidote_tpu.log import mark_dir_retired, stamp_layout_epoch
 
     old_epoch = int((meta or {}).get("layout_epoch", 0))
     new_epoch = old_epoch + 1

@@ -313,10 +313,16 @@ class ProtocolServer:
         store = txm.store
         if store.mutation_epoch == self._epoch_pub_mutations:
             return  # nothing new committed since the last freeze
+        # freeze only tables whose reads actually took the slow path
+        # since the last freeze: while every read is provably fresh the
+        # copies would be pure overhead (head copies are not free on a
+        # small host)
         self._last_epoch_pub = now
         self._epoch_pub_mutations = store.mutation_epoch
         for t in store.tables.values():
-            t.publish_epoch()
+            if t.slow_serves != getattr(t, "_pub_slow_serves", -1):
+                t._pub_slow_serves = t.slow_serves
+                t.publish_epoch()
 
     def _run_read_group(self, works: List[_StaticWork]) -> None:
         # requests whose causal clock is already covered locally merge

@@ -258,6 +258,10 @@ class TypedTable:
         # stays alive; at most ``_EPOCH_CAP`` kept).
         self.epochs: list = []
         self._epoch_uses = 0
+        #: serves that missed both gather fast paths (epoch publication
+        #: is pointless while every read is provably fresh — publishers
+        #: key off this)
+        self.slow_serves = 0
 
     # ------------------------------------------------------------------
     # row allocation / growth
@@ -677,6 +681,7 @@ class TypedTable:
                 epoch["head"], epoch["head_vc"], shards, rows, read_vcs
             )
             return resolved, fresh, fresh
+        self.slow_serves += 1
         if epoch is not None:
             src_head, src_vc = epoch["head"], epoch["head_vc"]
         else:
