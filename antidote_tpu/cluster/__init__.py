@@ -39,6 +39,25 @@ def cluster_query_router(members_by_dc: Dict[int, int], n_shards: int):
     return route
 
 
+class _LiveShards:
+    """A live view of a member's owned-shard set for its inter-DC
+    endpoint: the member reassigns the underlying set copy-on-write at
+    live membership moves, so a frozen copy would keep heartbeating (and
+    claiming) shards that moved away."""
+
+    def __init__(self, member: ClusterMember):
+        self._member = member
+
+    def __contains__(self, s) -> bool:
+        return s in self._member.shards
+
+    def __iter__(self):
+        return iter(self._member.shards)
+
+    def __len__(self) -> int:
+        return len(self._member.shards)
+
+
 def attach_interdc(member: ClusterMember, fabric, name: str = ""):
     """Run a cluster member's inter-DC endpoint: a DCReplica restricted
     to the member's owned shards, publishing under the member's fabric
@@ -47,16 +66,24 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
     The safe time for shard s is the sequencer counter when the member
     holds no prepared/chain-buffered txn touching s (any future commit's
     ts will exceed the counter), else the shard's applied chain frontier
-    (an outstanding prepared txn may already hold a smaller issued ts)."""
+    (an outstanding prepared txn may already hold a smaller issued ts).
+
+    KNOWN LIMITATION (documented, not silent): combining LIVE membership
+    change with geo-replication leaves inter-DC catch-up routing on the
+    boot-time modular map (cluster_query_router) — remote DCs learn the
+    new publisher layout only on reconnect.  Single-DC clusters (no
+    remote subscribers) are unaffected."""
     from antidote_tpu.interdc.replica import DCReplica
 
     replica = DCReplica(
         member.node, fabric, name=name or f"dc{member.dc_id}m{member.member_id}",
-        shards=member.shards,
+        shards=_LiveShards(member),
         fabric_id=fabric_id_of(member.dc_id, member.member_id),
     )
     def safe_time(shard: int) -> int:
-        if member.prepared_on_shard(shard) or member.chain_wait[shard]:
+        if (shard not in member.shards
+                or member.prepared_on_shard(shard)
+                or member.chain_wait.get(shard)):
             return member.applied_ts.get(shard, 0)
         return max(member._seq_counter(), member.applied_ts.get(shard, 0))
 
@@ -72,7 +99,7 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
         key = freeze_key(payload["key"])
         bucket = payload["bucket"]
         shard = key_to_shard(key, bucket, member.cfg.n_shards)
-        owner = shard % member.n_members
+        owner = member.shard_map.get(shard, shard % member.n_members)
         if owner == member.member_id:
             return member.m_process_transfer(
                 key, bucket, payload["amount"], payload["to_dc"])

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     ap.add_argument("--log-dir", default=None)
     ap.add_argument("--recover", action="store_true",
                     help="rejoin: replay the WAL + prepare log")
+    ap.add_argument("--joining", action="store_true",
+                    help="boot OWNING NOTHING: the live-join protocol "
+                         "(cluster.join.live_join) streams this member's "
+                         "shard share over while the cluster serves")
     args = ap.parse_args(argv)
 
     from antidote_tpu.config import (apply_jax_platform_env,
@@ -55,7 +59,8 @@ def main(argv=None) -> int:
     cfg = AntidoteConfig(n_shards=args.shards, max_dcs=args.max_dcs)
     member = ClusterMember(cfg, dc_id=args.dc_id, member_id=args.member,
                            n_members=args.members, log_dir=args.log_dir,
-                           recover=args.recover)
+                           recover=args.recover,
+                           shards=[] if args.joining else None)
     fabric = TcpFabric()
     replica = attach_interdc(member, fabric)
     node = ClusterNode(member)

@@ -1123,7 +1123,7 @@ class ClusterMember:
                     _, tx_shards, prev, _ = issued
                     pw = {int(k): int(v) for k, v in prev.items()}
                     self.m_forget_txn(txid, ts, tx_shards, pw)
-                    for mid, cli in self.peers.items():
+                    for mid, cli in list(self.peers.items()):
                         try:
                             cli.call("m_forget_txn", txid, ts, tx_shards,
                                      pw)
@@ -1155,7 +1155,7 @@ class ClusterMember:
                 except Exception:
                     log.warning("takeover: local completion of txn %d "
                                 "failed", txid, exc_info=True)
-                for mid, cli in self.peers.items():
+                for mid, cli in list(self.peers.items()):
                     try:
                         cli.call("m_commit", txid, vc, pw, True)
                     except Exception as e:
@@ -1165,7 +1165,7 @@ class ClusterMember:
 
     def _poll(self, method: str, txid: int) -> Dict[int, list]:
         out = {self.member_id: getattr(self, method)(txid)}
-        for mid, cli in self.peers.items():
+        for mid, cli in list(self.peers.items()):
             try:
                 out[mid] = cli.call(method, txid)
             except Exception:
@@ -1204,7 +1204,7 @@ class ClusterMember:
             return ["wait", int(txid)]  # an owner died mid-barrier
         prev_wire = {int(k): int(v) for k, v in prev.items()}
         self.m_forget_txn(txid, ts, tx_shards, prev_wire)
-        for mid, cli in self.peers.items():
+        for mid, cli in list(self.peers.items()):
             try:
                 cli.call("m_forget_txn", txid, ts, tx_shards, prev_wire)
             except Exception as e:
@@ -1237,7 +1237,7 @@ class ClusterMember:
         if txid in self.seq.txid_index:
             return ["sequenced", self.seq.txid_index[txid]]
         self.m_forget_txn(txid, 0, [], {})
-        for cli in self.peers.values():
+        for cli in list(self.peers.values()):
             try:
                 cli.call("m_forget_txn", txid, 0, [], {})
             except Exception:
@@ -1318,6 +1318,11 @@ class ClusterMember:
         """Apply when the shard's own-lane chain reaches ``prev``; buffer
         otherwise (commits may arrive out of ts order from concurrent
         coordinators)."""
+        if shard not in self.chain_wait:
+            raise RuntimeError(
+                f"commit ts {ts} for unowned shard {shard} at member "
+                f"{self.member_id} (owned {sorted(self.shards)}, map "
+                f"{self.shard_map.get(shard)}) — protocol violation")
         if self.applied_ts[shard] < prev:
             self.chain_wait[shard][prev] = (ts, effects, commit_vc)
             return
@@ -1396,7 +1401,7 @@ class ClusterMember:
 
     def close(self) -> None:
         self.rpc.close()
-        for cli in self.peers.values():
+        for cli in list(self.peers.values()):
             cli.close()
         if self._prep_wal is not None:
             self._prep_wal.close()

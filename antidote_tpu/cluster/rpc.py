@@ -9,6 +9,7 @@ one connection per (thread, target) like the inter-DC query channel.
 
 from __future__ import annotations
 
+import logging
 import socket
 import socketserver
 import struct
@@ -16,6 +17,8 @@ import threading
 from typing import Any, Callable, Dict
 import msgpack
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 _HDR = struct.Struct(">I")
 
@@ -72,6 +75,20 @@ class RpcServer:
                         fn = srv_self.handlers[req["m"]]
                         reply = {"ok": fn(*req.get("a", []))}
                     except Exception as e:
+                        # expected protocol errors (aborts, ownership
+                        # retries) stay quiet; anything else is a real
+                        # handler bug — log the traceback server-side,
+                        # the wire reply carries only the message.
+                        # Protocol errors follow the PREFIX convention
+                        # ("abort: ...", "not_owner: ...", "busy: ...")
+                        # — substring matching would silence real bugs
+                        # whose text merely contains those words
+                        if not str(e).startswith(
+                            ("abort", "not_owner", "busy",
+                             "overlay-resync")
+                        ):
+                            log.exception("rpc handler %r failed",
+                                          req.get("m"))
                         reply = {"err": f"{type(e).__name__}: {e}"}
                     try:
                         _send(self.request, reply)

@@ -54,8 +54,12 @@ class DCReplica:
         #: a multi-node DC's members each publish/ingest only their own
         #: shards' chains (one publisher per (origin, shard), like the
         #: reference's per-partition log senders)
+        # any iterable-with-membership works — cluster members pass a
+        # LIVE view so the endpoint tracks ownership through live
+        # membership moves (a frozen copy kept heartbeating shards that
+        # had moved away)
         self.shards = (set(range(node.cfg.n_shards)) if shards is None
-                       else set(shards))
+                       else shards)
         #: id this endpoint registers under on the fabric — cluster
         #: members of one DC need distinct endpoints (dc_id stays the
         #: semantic origin in every message)

@@ -311,18 +311,23 @@ class ProtocolServer:
         if now - self._last_epoch_pub < self.EPOCH_PUBLISH_S:
             return
         store = txm.store
-        if store.mutation_epoch == self._epoch_pub_mutations:
-            return  # nothing new committed since the last freeze
-        # freeze only tables whose reads actually took the slow path
-        # since the last freeze: while every read is provably fresh the
-        # copies would be pure overhead (head copies are not free on a
-        # small host)
-        self._last_epoch_pub = now
-        self._epoch_pub_mutations = store.mutation_epoch
+        # freeze a table when (a) new commits landed since its last
+        # freeze AND (b) some read actually took the slow path since
+        # then — (a) alone copies heads for workloads that never fold,
+        # (b) alone is satisfied forever by one old historical read.
+        # Checked PER TABLE so a slow read arriving after writes
+        # quiesced still gets its epoch on the next tick (the global
+        # early-return variant starved exactly that case).
+        published = False
         for t in store.tables.values():
-            if t.slow_serves != getattr(t, "_pub_slow_serves", -1):
+            if (t.slow_serves != getattr(t, "_pub_slow_serves", -1)
+                    and store.mutation_epoch != getattr(t, "_pub_mut", -1)):
                 t._pub_slow_serves = t.slow_serves
+                t._pub_mut = store.mutation_epoch
                 t.publish_epoch()
+                published = True
+        if published:
+            self._last_epoch_pub = now
 
     def _run_read_group(self, works: List[_StaticWork]) -> None:
         # requests whose causal clock is already covered locally merge
