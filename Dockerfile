@@ -10,6 +10,10 @@ ENV PB_PORT=8087 \
     DC_ID=0 \
     SHARDS=16 \
     MAX_DCS=8 \
+    KEYS_PER_TABLE=65536 \
+    INTERDC=1 \
+    INTERDC_PORT=8086 \
+    PUBLIC_HOST="" \
     DATA_DIR=/data \
     JAX_PLATFORMS=cpu
 
@@ -25,9 +29,19 @@ RUN python -c "from antidote_tpu.log.wal import _load_lib; assert _load_lib()" \
     && python -c "from antidote_tpu.store.router import shard_batch; shard_batch(['k'], ['b'], 4)"
 
 VOLUME /data
-EXPOSE 8087 3001
+EXPOSE 8087 8086 3001
 
-ENTRYPOINT ["sh", "-c", "exec python -m antidote_tpu.console serve \
+# INTERDC=1 attaches the geo-replication plane on the fixed
+# INTERDC_PORT (publishable through -p); set PUBLIC_HOST to the name
+# remote DCs reach this container by — descriptors advertise it.
+# Any other INTERDC value (0/false/empty) serves a standalone DC.
+ENTRYPOINT ["sh", "-c", "IFLAGS=''; \
+    if [ \"${INTERDC}\" = \"1\" ]; then \
+      IFLAGS=\"--interdc --interdc-port ${INTERDC_PORT}\"; \
+      [ -n \"${PUBLIC_HOST}\" ] && IFLAGS=\"$IFLAGS --public-host ${PUBLIC_HOST}\"; \
+    fi; \
+    exec python -m antidote_tpu.console serve \
     --host ${PB_IP} --port ${PB_PORT} --metrics-port ${METRICS_PORT} \
     --dc-id ${DC_ID} --shards ${SHARDS} --max-dcs ${MAX_DCS} \
+    --keys-per-table ${KEYS_PER_TABLE} ${IFLAGS} \
     --log-dir ${DATA_DIR}"]

@@ -97,7 +97,15 @@ def cmd_serve(args) -> int:
         from antidote_tpu.interdc import DCReplica
         from antidote_tpu.interdc.tcp import TcpFabric
 
-        fabric = TcpFabric(host=args.host)
+        public = args.public_host
+        if public is None and args.host not in ("0.0.0.0", "::"):
+            public = args.host
+        fabric = TcpFabric(host=args.host, port=args.interdc_port,
+                           public_host=public)
+        if public is None:
+            log("WARNING: binding inter-DC on a wildcard address with no "
+                "--public-host: connection descriptors will advertise the "
+                "bind address, which remote DCs cannot reach")
         interdc = DCReplica(node, fabric, name=f"dc{args.dc_id}")
         if recover:
             interdc.restore_from_log()
@@ -274,6 +282,13 @@ def main(argv=None) -> int:
                     help="attach the inter-DC replication plane (TCP "
                          "fabric + replica) so clients can bootstrap a "
                          "DC mesh over the protocol")
+    sv.add_argument("--interdc-port", type=int, default=0,
+                    help="fixed listen port for the inter-DC fabric "
+                         "(0 = ephemeral; fix it to publish through a "
+                         "container/firewall boundary)")
+    sv.add_argument("--public-host", default=None,
+                    help="address advertised in connection descriptors "
+                         "(required for remote DCs when binding 0.0.0.0)")
     sv.add_argument("--keys-per-table", type=int, default=4096,
                     help="initial rows per (type, shard); size near the "
                          "expected keyspace — every growth doubling "

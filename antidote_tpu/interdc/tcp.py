@@ -193,8 +193,18 @@ class TcpFabric:
     /root/reference/src/inter_dc_manager.erl:67-109).
     """
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 public_host: Optional[str] = None):
         self.host = host
+        #: fixed listen port for the FIRST registered endpoint (0 =
+        #: ephemeral).  Deployments binding 0.0.0.0 need a publishable
+        #: port — an ephemeral one can't be mapped through a container
+        #: boundary or firewall
+        self._bind_port = port
+        #: address advertised in connection descriptors; a 0.0.0.0 bind
+        #: address is meaningless to a REMOTE DC (it would connect to
+        #: itself), so operators set the reachable name here
+        self.public_host = public_host
         self.endpoints: Dict[int, _Endpoint] = {}
         #: dc_id -> tick callback (deferred-heartbeat flush at pump)
         self._ticks: Dict[int, Callable] = {}
@@ -209,10 +219,13 @@ class TcpFabric:
 
     # -- LoopbackHub interface -----------------------------------------
     def register(self, dc_id: int, on_message, query_handler) -> None:
-        ep = _Endpoint(self, dc_id, self.host, 0)
+        # the fixed port (if any) goes to the first endpoint; in-process
+        # multi-DC tests register several per fabric and keep ephemeral
+        port = self._bind_port if not self.endpoints else 0
+        ep = _Endpoint(self, dc_id, self.host, port)
         ep.query_handler = query_handler
         self.endpoints[dc_id] = ep
-        self.addresses[dc_id] = (ep.host, ep.port)
+        self.addresses[dc_id] = (self.public_host or ep.host, ep.port)
 
     def register_request(self, dc_id: int, handler) -> None:
         self.endpoints[dc_id].request_handler = handler

@@ -49,7 +49,14 @@ def _key_on_member(cfg, member, tag="k"):
 
 def _wedge(coord, m_dead_side, updates):
     """Simulate a coordinator crash after sequencing, before ANY commit
-    fan-out: prepare everywhere + take a ts, then stop."""
+    fan-out: prepare everywhere + take a ts, then stop.
+
+    The coordinator's cached sequencer frontier refreshes on a 0.2 s
+    cadence; a snapshot taken inside that window after ANOTHER
+    coordinator's commit would cert-conflict (by design — clients
+    retry).  This helper wedges exactly one txn, so take the snapshot
+    at a fresh frontier instead of retrying."""
+    coord.member.invalidate_seq_cache()
     txn = coord.start_transaction()
     coord._update(updates, txn)
     by_owner = {}
