@@ -213,6 +213,19 @@ class TcpFabric:
         #: subscriber-side inbox: (on_message, data) pairs await pump()
         self.inbox: "queue.Queue" = queue.Queue()
         self._readers: List[threading.Thread] = []
+        #: native receive plane (cpp/pump.cc): ONE epoll thread owns all
+        #: subscription sockets — kernel reads + framing in C++ (the
+        #: libzmq io-thread role, SURVEY §2.9); None = build/load
+        #: failed, per-subscription Python readers take over
+        from antidote_tpu.interdc.native_pump import NativePump
+
+        import collections as _collections
+
+        self._np = NativePump.create()
+        self._np_tags: Dict[int, Callable] = {}
+        self._np_next = 1
+        #: decoded frames awaiting delivery (batch drains outpace pump)
+        self._np_ready: "_collections.deque" = _collections.deque()
         self._query_conns: Dict[Tuple[int, int], socket.socket] = {}
         self._query_lock = threading.Lock()
         self.delivered = 0
@@ -244,9 +257,16 @@ class TcpFabric:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send(sock, K_SUB, subscriber_dc)
         # wait for the registration ack before handing the socket to the
-        # reader thread — subscribe() returning means the stream is live
+        # reader — subscribe() returning means the stream is live
         kind, _ = _recv(sock)
         assert kind == K_REPLY, kind
+        if self._np is not None:
+            # native plane: hand the raw fd to the epoll pump
+            tag = self._np_next
+            self._np_next += 1
+            self._np_tags[tag] = on_message
+            self._np.add(sock.detach(), tag)
+            return
 
         def reader():
             try:
@@ -298,23 +318,43 @@ class TcpFabric:
 
     def pump(self, max_rounds: int = 100_000, timeout: float = 0.5) -> int:
         """Deliver queued stream messages on the calling thread until the
-        fabric is quiescent for ``timeout`` seconds.
+        fabric is quiescent.
 
-        Ticks (deferred-heartbeat flushes) re-run whenever the inbox goes
-        idle, mirroring LoopbackHub.pump: a commit made by a server thread
-        MID-pump (e.g. a bcounter grant) still flushes its safe time
-        before this pump returns."""
+        Quiescence contract: "no traffic beyond two rounds of tick
+        output" — ticks (deferred-heartbeat flushes) run at entry, at
+        the first idle, and ONCE MORE at return (so a commit made by a
+        server thread mid-pump still flushes its safe time before this
+        pump returns), but tick-generated frames past the budget wait
+        for the next pump: with the native receive plane our own pings
+        arrive fast enough that an unbounded drain-ticks loop would
+        never terminate."""
         n = 0
+        # ticks may PUBLISH (heartbeat pings), and with the native
+        # receive plane our own pings come back fast enough to keep the
+        # loop busy forever — bound the flushes per pump() call so
+        # "quiescent" means "no traffic beyond two rounds of tick
+        # output", the LoopbackHub contract
+        tick_budget = 2
         for fn in list(self._ticks.values()):
             fn()
+        tick_budget -= 1
         while n < max_rounds:
             try:
-                cb, data = self.inbox.get(timeout=timeout)
+                cb, data = self._get_message(timeout)
             except queue.Empty:
+                if tick_budget <= 0:
+                    # final flush WITHOUT re-draining: safe times of
+                    # commits made mid-pump still reach the wire before
+                    # we return (the documented invariant); any frames
+                    # they generate are the next pump's work
+                    for fn in list(self._ticks.values()):
+                        fn()
+                    return n
+                tick_budget -= 1
                 for fn in list(self._ticks.values()):
                     fn()
                 try:
-                    cb, data = self.inbox.get_nowait()
+                    cb, data = self._get_message(0.05)
                 except queue.Empty:
                     return n
             # take the local handler locks so server threads (queries,
@@ -324,6 +364,37 @@ class TcpFabric:
             self.delivered += 1
             n += 1
         return n
+
+    def _get_message(self, timeout: float):
+        """Next (on_message, data) from the Python inbox or the native
+        pump, whichever has one first; raises queue.Empty on timeout.
+        Native frames arrive in BATCHES (one ctypes crossing drains up
+        to 512) and carry the raw wire payload — unpack here."""
+        if self._np is None:
+            return self.inbox.get(timeout=timeout)
+        if self._np_ready:
+            return self._np_ready.popleft()
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while True:
+            if self.inbox.qsize():
+                try:
+                    return self.inbox.get_nowait()
+                except queue.Empty:
+                    pass
+            rem = deadline - _t.monotonic()
+            wait_ms = max(1, int(min(rem, 0.05) * 1000)) if rem > 0 else 1
+            for tag, kind, payload in self._np.take_batch(wait_ms):
+                cb = self._np_tags.get(tag)
+                if cb is not None and kind == K_PUSH:
+                    body = msgpack.unpackb(payload, raw=False,
+                                           strict_map_key=False)
+                    self._np_ready.append((cb, bytes(body)))
+            if self._np_ready:
+                return self._np_ready.popleft()
+            if rem <= 0:
+                raise queue.Empty
 
     def _local_locks(self):
         """A context manager holding every local endpoint's handler lock."""
@@ -351,6 +422,9 @@ class TcpFabric:
                     a.addresses.setdefault(dc, addr)
 
     def close(self) -> None:
+        if self._np is not None:
+            self._np.close()
+            self._np = None
         for ep in self.endpoints.values():
             ep.close()
         with self._query_lock:
