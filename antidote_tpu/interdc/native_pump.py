@@ -10,6 +10,7 @@ failure falls back to the per-subscription Python reader threads.
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
 import subprocess
 from typing import Optional, Tuple
@@ -74,8 +75,6 @@ class NativePump:
 
     @staticmethod
     def create() -> Optional["NativePump"]:
-        import os
-
         if os.environ.get("ANTIDOTE_NATIVE_PUMP", "on") == "off":
             return None
         lib = _load_lib()
@@ -85,8 +84,6 @@ class NativePump:
         """Register a connected socket fd; the pump OWNS it from here
         (pass ``sock.detach()``)."""
         if self._h is None:
-            import os
-
             os.close(fd)  # closed pump: don't leak the detached fd
             return
         self._lib.pump_add(self._h, fd, tag)

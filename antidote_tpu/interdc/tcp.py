@@ -18,9 +18,11 @@ gave the reference).
 
 from __future__ import annotations
 
+import collections
 import queue
 import socket
 import socketserver
+import time
 import struct
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
@@ -219,13 +221,11 @@ class TcpFabric:
         #: failed, per-subscription Python readers take over
         from antidote_tpu.interdc.native_pump import NativePump
 
-        import collections as _collections
-
         self._np = NativePump.create()
         self._np_tags: Dict[int, Callable] = {}
         self._np_next = 1
         #: decoded frames awaiting delivery (batch drains outpace pump)
-        self._np_ready: "_collections.deque" = _collections.deque()
+        self._np_ready: "collections.deque" = collections.deque()
         self._query_conns: Dict[Tuple[int, int], socket.socket] = {}
         self._query_lock = threading.Lock()
         self.delivered = 0
@@ -356,6 +356,11 @@ class TcpFabric:
                 try:
                     cb, data = self._get_message(0.05)
                 except queue.Empty:
+                    # same final-flush invariant as the exhausted-budget
+                    # branch: safe times of mid-pump commits reach the
+                    # wire before returning
+                    for fn in list(self._ticks.values()):
+                        fn()
                     return n
             # take the local handler locks so server threads (queries,
             # bcounter grants) never interleave with gate processing
@@ -372,19 +377,14 @@ class TcpFabric:
         to 512) and carry the raw wire payload — unpack here."""
         if self._np is None:
             return self.inbox.get(timeout=timeout)
+        # native mode: the inbox is never fed (subscribe hands every fd
+        # to the pump), so block straight on the native queue
         if self._np_ready:
             return self._np_ready.popleft()
-        import time as _t
-
-        deadline = _t.monotonic() + timeout
+        deadline = time.monotonic() + timeout
         while True:
-            if self.inbox.qsize():
-                try:
-                    return self.inbox.get_nowait()
-                except queue.Empty:
-                    pass
-            rem = deadline - _t.monotonic()
-            wait_ms = max(1, int(min(rem, 0.05) * 1000)) if rem > 0 else 1
+            rem = deadline - time.monotonic()
+            wait_ms = max(1, int(rem * 1000)) if rem > 0 else 1
             for tag, kind, payload in self._np.take_batch(wait_ms):
                 cb = self._np_tags.get(tag)
                 if cb is not None and kind == K_PUSH:

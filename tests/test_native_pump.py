@@ -1,0 +1,97 @@
+"""Native inter-DC receive pump (interdc/cpp/pump.cc) edge cases.
+
+The integration suites (test_tcp_interdc, test_dc_management) already
+exercise the happy path end to end; these pin the contract details a
+transport must not regress on: partial-frame reassembly, multi-frame
+segments, EOF tail delivery, batch drains, closed-pump behavior, and
+the Python-reader fallback toggle.
+"""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from antidote_tpu.interdc.native_pump import NativePump
+
+pytestmark = pytest.mark.smoke
+
+_HDR = struct.Struct(">IB")
+
+
+def _frame(kind: int, payload: bytes) -> bytes:
+    return _HDR.pack(len(payload) + 1, kind) + payload
+
+
+@pytest.fixture
+def pump():
+    p = NativePump.create()
+    if p is None:
+        pytest.skip("native pump unavailable (no g++/epoll)")
+    yield p
+    p.close()
+
+
+def test_reassembly_and_batching(pump):
+    a, b = socket.socketpair()
+    pump.add(b.detach(), tag=3)
+    # three frames: one split across sends, two glued in one segment
+    f1, f2, f3 = (_frame(2, b"x" * 10), _frame(2, b"y" * 1000),
+                  _frame(7, b"z"))
+    a.sendall(f1[:7])
+    time.sleep(0.02)
+    a.sendall(f1[7:] + f2 + f3)
+    got = []
+    deadline = time.time() + 5
+    while len(got) < 3 and time.time() < deadline:
+        got.extend(pump.take_batch(200))
+    assert [(t, k, len(p)) for t, k, p in got] == [
+        (3, 2, 10), (3, 2, 1000), (3, 7, 1)]
+    a.close()
+
+
+def test_eof_tail_delivered(pump):
+    """Frames sent immediately before the peer closes must still be
+    delivered (the stream's last commits ride exactly there)."""
+    a, b = socket.socketpair()
+    pump.add(b.detach(), tag=9)
+    a.sendall(_frame(2, b"final-1") + _frame(2, b"final-2"))
+    a.close()  # EOF races the reads
+    got = []
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        got.extend(pump.take_batch(200))
+    assert [p for _, _, p in got] == [b"final-1", b"final-2"]
+
+
+def test_large_frame_grows_buffer(pump):
+    a, b = socket.socketpair()
+    pump.add(b.detach(), tag=1)
+    big = b"B" * (2 << 20)  # larger than the 1 MiB scratch buffer
+    a.sendall(_frame(2, big))
+    got = []
+    deadline = time.time() + 10
+    while not got and time.time() < deadline:
+        got.extend(pump.take_batch(200))
+    assert got[0][2] == big
+    a.close()
+
+
+def test_closed_pump_is_inert():
+    p = NativePump.create()
+    if p is None:
+        pytest.skip("native pump unavailable")
+    a, b = socket.socketpair()
+    p.close()
+    p.add(b.detach(), tag=1)  # fd closed, not leaked
+    assert p.take(10) is None
+    assert p.take_batch(10) == []
+    assert p.queued() == 0
+    p.close()  # idempotent
+    a.close()
+
+
+def test_env_toggle_forces_fallback(monkeypatch):
+    monkeypatch.setenv("ANTIDOTE_NATIVE_PUMP", "off")
+    assert NativePump.create() is None
