@@ -5,13 +5,20 @@
 
 PY ?= python
 
-.PHONY: test smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos lint bench bench-wire multichip all
 
 all: lint smoke
 
-# full suite (serial; ~10-12 min on the 1-core CI host)
+# full suite (serial; ~10-12 min on the 1-core CI host); long chaos
+# soaks are opt-in via `make chaos`
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# the whole fault-injection suite INCLUDING the slow soaks: seeded
+# partitions, endpoint crash/restart, drop/dup/delay storms, mid-handoff
+# crashes — every scenario ends with byte-identical converged snapshots
+chaos:
+	$(PY) -m pytest tests/test_chaos.py -q
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

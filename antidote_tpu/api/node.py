@@ -198,6 +198,12 @@ class AntidoteNode:
             },
             "durable": self.store.log is not None,
         }
+        # fabric/RPC resilience counters (process-wide; see NetMetrics):
+        # operators watch these to see partitions heal and retries drain
+        from antidote_tpu.obs.metrics import net_metrics
+
+        out["net"] = {k: v for k, v in net_metrics().snapshot().items()
+                      if v}
         if include_ready:
             out["ready"] = self.check_ready()
         return out

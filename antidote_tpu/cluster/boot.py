@@ -24,7 +24,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import threading
 import time
 
 
@@ -80,10 +79,26 @@ def main(argv=None) -> int:
             fid = int(fid)
             if fid != replica.fabric_id and (fid & 0xFFFF) != member.dc_id:
                 fabric.subscribe(replica.fabric_id, fid, replica._on_message)
-        # background pump: deliver the inter-DC stream + flush heartbeats
-        t = threading.Thread(target=_pump_loop, args=(fabric,), daemon=True,
-                             name="interdc-pump")
-        t.start()
+        # background pump: deliver the inter-DC stream + flush
+        # heartbeats.  Supervised (5-in-10s, like console serve): a
+        # crashed drain loop restarts loudly instead of silently
+        # freezing geo-replication for this member
+        from antidote_tpu.supervise import Supervisor, ThreadLoop
+
+        old = getattr(fabric, "_pump_sup", None)
+        if old is not None:  # re-wire: replace, don't stack pump loops
+            old.shutdown()
+        sup = Supervisor()
+        sup.add(
+            "interdc-pump",
+            start=lambda: ThreadLoop(
+                lambda: fabric.pump(timeout=0.2), interval_s=0.01,
+                name="interdc-pump").start(),
+            alive=lambda lp: lp.is_alive(),
+            stop=lambda lp: lp.stop(),
+        )
+        sup.start()
+        fabric._pump_sup = sup
         return True
 
     member.rpc.register("ctl_wire", ctl_wire)
@@ -112,14 +127,6 @@ def main(argv=None) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         return 0
-
-
-def _pump_loop(fabric) -> None:
-    while True:
-        try:
-            fabric.pump(timeout=0.2)
-        except Exception:
-            time.sleep(0.2)
 
 
 if __name__ == "__main__":

@@ -98,17 +98,24 @@ class ClusterNode:
 
     def _refresh_shard_map(self) -> None:
         """Pull the current ownership map from any peer (after a
-        not_owner reply: a live join/leave moved a shard under us)."""
+        not_owner reply: a live join/leave moved a shard under us).
+        Entries are (owner, epoch) and only STRICTLY NEWER epochs are
+        adopted — a peer whose map predates a move must never clobber
+        what the move's broadcast already taught us (two members doing
+        that to each other never reconverges)."""
         for mid, cli in list(self.member.peers.items()):
             try:
                 m = cli.call("m_shard_map")
             except Exception:
                 continue
             with self.member._lock:
-                for s, owner in m.items():
+                for s, ent in m.items():
                     s = int(s)
-                    if s not in self.member.shards:
-                        self.member.shard_map[s] = int(owner)
+                    owner, epoch = int(ent[0]), int(ent[1])
+                    if (s not in self.member.shards
+                            and epoch > self.member.shard_epoch.get(s, 0)):
+                        self.member.shard_map[s] = owner
+                        self.member.shard_epoch[s] = epoch
             return
 
     def _owner_of(self, key, bucket) -> Optional[int]:
@@ -401,6 +408,8 @@ class ClusterNode:
         if not txn.writeset:
             return txn.snapshot_vc.copy()
         snap_own = int(txn.snapshot_vc[self.dc_id])
+        last_busy = None
+        t_retry0 = time.monotonic()
         for moves in range(200):
             by_owner: Dict[Optional[int], list] = {}
             shards = set()
@@ -429,6 +438,7 @@ class ClusterNode:
                 if "not_owner" in str(e) or "busy" in str(e):
                     # live shard move in flight: re-route and re-prepare
                     # (the aborts released any locks already taken)
+                    last_busy = e
                     self._refresh_shard_map()
                     time.sleep(0.02)
                     continue
@@ -446,7 +456,9 @@ class ClusterNode:
                 raise
         else:
             raise RuntimeError(
-                "shard ownership unstable: prepare retries exhausted")
+                "shard ownership unstable: prepare retries exhausted "
+                f"after {time.monotonic() - t_retry0:.2f}s "
+                f"(last: {last_busy})") from last_busy
         # one DC-wide timestamp + per-shard chains from the sequencer
         # (ledgered under the txid so takeover can find this txn)
         ts, prev = self._seq(sorted(shards), txn.txid)

@@ -31,10 +31,13 @@ the offline resize tool's job.)
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, List, Tuple
 
 from antidote_tpu.cluster.rpc import RpcClient
+
+log = logging.getLogger(__name__)
 
 #: per-shard move retry budget (a staged txn pins a shard only for the
 #: prepare→commit window; 400 × 25 ms rides out seconds of contention)
@@ -57,24 +60,56 @@ def _retry_call(cli: RpcClient, method: str, *args, tries: int = _MOVE_TRIES):
 
 def _move_shard(clients: Dict[int, RpcClient], shard: int, src: int,
                 dst: int, n_members: int) -> None:
+    """Two-phase move: export a COPY, confirm the import landed, then
+    relinquish the source.  The source keeps the only durable copy (and
+    ownership) until the relinquish, so a driver crash at ANY point
+    leaves a live copy: before relinquish the source still serves (after
+    a cancel/restart clears the volatile mid-move mark); at/after
+    relinquish the import has already been confirmed."""
+    t0 = time.monotonic()
     data = _retry_call(clients[src], "m_export_shard", shard, dst)
-    # the package is the ONLY copy until the import lands: retry the
-    # import (idempotent at the destination), never re-export
+    t_exp = time.monotonic()
     last = None
     for _ in range(10):
         try:
             clients[dst].call("m_import_shard", data)
             break
-        except Exception as e:  # transient RPC hiccup
+        except Exception as e:  # transient RPC hiccup (import idempotent)
+            last = e
+            time.sleep(0.1)
+    else:
+        # import never landed: reopen the source shard and give up —
+        # nothing was dropped, no data is at risk
+        try:
+            clients[src].call("m_cancel_export", shard)
+        except Exception:
+            pass  # source crash/unreachable: its restart clears the mark
+        raise RuntimeError(
+            f"shard {shard} import at member {dst} kept failing"
+        ) from last
+    # phase 2: the import is confirmed durable at dst — now (and only
+    # now) drop the source copy; idempotent, so retry transient errors
+    last = None
+    for _ in range(10):
+        try:
+            epoch = clients[src].call("m_relinquish_shard", shard, dst)
+            break
+        except Exception as e:
             last = e
             time.sleep(0.1)
     else:
         raise RuntimeError(
-            f"shard {shard} import at member {dst} kept failing"
+            f"shard {shard} relinquish at member {src} kept failing "
+            "(both members now hold a copy; re-run the move driver)"
         ) from last
+    # broadcast carries the move's epoch so stale maps can't clobber it
     for m, c in clients.items():
         if m not in (src, dst):
-            c.call("m_set_owner", shard, dst, n_members)
+            c.call("m_set_owner", shard, dst, n_members, epoch)
+    t_done = time.monotonic()
+    log.info("moved shard %d: %d -> %d (export wait %.3fs, "
+             "import+relinquish+broadcast %.3fs)",
+             shard, src, dst, t_exp - t0, t_done - t_exp)
 
 
 def plan_moves(shard_map: Dict[int, int], n_new: int
@@ -101,7 +136,7 @@ def live_join(rpcs: Dict[int, Tuple[str, int]], new_id: int) -> int:
     try:
         for m, c in clients.items():
             c.call("m_join_begin", new_id, list(rpcs[new_id]), n_new)
-        cur = {int(s): int(o)
+        cur = {int(s): int(o[0])
                for s, o in clients[0].call("m_shard_map").items()}
         moves = plan_moves(cur, n_new)
         for shard, src, dst in moves:
@@ -127,7 +162,7 @@ def live_leave(rpcs: Dict[int, Tuple[str, int]], leaving_id: int) -> int:
     clients = {m: RpcClient(*a) for m, a in rpcs.items()}
     try:
         n_new = leaving_id
-        cur = {int(s): int(o)
+        cur = {int(s): int(o[0])
                for s, o in clients[0].call("m_shard_map").items()}
         moves = plan_moves(cur, n_new)
         for shard, src, dst in moves:

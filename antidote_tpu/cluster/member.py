@@ -191,8 +191,19 @@ class ClusterMember:
         #: riak_core ring analogue).  Starts modular; live join/leave
         #: updates it in lock-step with the data moves, and stale
         #: coordinators converge through not_owner retry.
+        #
+        #: A live-joining member (explicit EMPTY shard set) boots with
+        #: the CURRENT layout — modular over the pre-join count — not
+        #: the future one: epoch-guarded refreshes never downgrade a
+        #: map entry, so a speculative future-layout guess would leave
+        #: the joiner routing to not-yet-owners for the whole join.
+        #: live_join enforces contiguous ids with the joiner last, so
+        #: the pre-join count is n_members - 1.
+        layout_n = n_members
+        if shards is not None and not self.shards and n_members > 1:
+            layout_n = n_members - 1
         self.shard_map: Dict[int, int] = {
-            s: s % n_members for s in range(cfg.n_shards)
+            s: s % layout_n for s in range(cfg.n_shards)
         }
         for s in self.shards:
             self.shard_map[s] = member_id
@@ -216,6 +227,19 @@ class ClusterMember:
         self.staged: Dict[int, Tuple[list, list]] = {}
         #: (key, bucket) -> own-lane ts of its last commit (cert table)
         self.last_commit: Dict[Tuple[Any, str], int] = {}
+        #: shards mid-move (exported, not yet relinquished): prepares and
+        #: reads refuse retryably so the in-flight package stays exact.
+        #: Deliberately VOLATILE — a crash wipes it, reopening the shard
+        #: (ownership only flips durably at relinquish)
+        self.moving: set = set()
+        #: per-shard ownership VERSION (the riak_core ring-epoch role):
+        #: every completed move bumps it by one, and stale gossip is
+        #: rejected by epoch comparison — without this, two members can
+        #: re-infect each other with a pre-move owner forever (each
+        #: pulling the other's stale map entry after a refresh race)
+        self.shard_epoch: Dict[int, int] = {
+            s: 0 for s in range(cfg.n_shards)
+        }
         #: per owned shard: last own-DC ts applied (chain frontier)
         self.applied_ts: Dict[int, int] = {s: 0 for s in self.shards}
         #: per shard: {prev_ts: (txid, effects, commit_vc)} awaiting chain
@@ -281,7 +305,8 @@ class ClusterMember:
                      "m_block_txn", "m_forget_txn", "m_resolve_chain",
                      "m_txn_sequenced", "m_resolve_stale_txn",
                      "m_process_transfer", "m_shard_map", "m_join_begin",
-                     "m_export_shard", "m_import_shard", "m_set_owner",
+                     "m_export_shard", "m_import_shard",
+                     "m_relinquish_shard", "m_cancel_export", "m_set_owner",
                      "m_forget_member"):
             self.rpc.register(name, getattr(self, name))
 
@@ -403,6 +428,9 @@ class ClusterMember:
                 }
                 for s in self.shards:
                     self.shard_map[s] = self.member_id
+                self.shard_epoch = {
+                    s: 0 for s in range(self.cfg.n_shards)
+                }
                 self.applied_ts = {s: 0 for s in self.shards}
                 self.chain_wait = {s: {} for s in self.shards}
             elif ev == "own":
@@ -410,6 +438,8 @@ class ClusterMember:
                 # crashing mid-join must rejoin with the moved layout)
                 s, owner = int(rec["shard"]), int(rec["owner"])
                 self.shard_map[s] = owner
+                self.shard_epoch[s] = int(rec.get(
+                    "epoch", self.shard_epoch.get(s, 0) + 1))
                 if owner == self.member_id:
                     self.shards.add(s)
                     self.applied_ts.setdefault(s, 0)
@@ -836,11 +866,20 @@ class ClusterMember:
         if shard not in self.shards:
             raise RuntimeError(
                 f"not_owner: shard {shard} owner "
-                f"{self.shard_map.get(shard, -1)}"
+                f"{self.shard_map.get(shard, -1)} "
+                f"(asked member {self.member_id})"
             )
+        if shard in self.moving:
+            # exported but not yet relinquished: new work would make the
+            # in-flight package stale — retryable, the window is the
+            # import RPC's round trip
+            raise RuntimeError(f"busy: shard {shard} mid-move")
 
     def m_shard_map(self) -> dict:
-        return {int(s): int(m) for s, m in self.shard_map.items()}
+        """{shard: [owner, epoch]} — epochs let pullers reject stale
+        entries (a refresh must never clobber newer knowledge)."""
+        return {int(s): [int(m), int(self.shard_epoch.get(int(s), 0))]
+                for s, m in self.shard_map.items()}
 
     def m_join_begin(self, new_id: int, new_addr, n_members_new: int) -> bool:
         """Learn a joining member: wire its RPC, grow the member count.
@@ -854,37 +893,60 @@ class ClusterMember:
         return True
 
     def m_set_owner(self, shard: int, owner: int,
-                    n_members: Optional[int] = None) -> bool:
+                    n_members: Optional[int] = None,
+                    epoch: Optional[int] = None) -> bool:
         """Record a completed shard move (driver broadcast).  The source
-        and destination already updated themselves durably in
-        export/import; everyone else updates the map here."""
+        and destination already updated themselves durably in the
+        import/relinquish phases; everyone else updates the map here.
+        A broadcast older than what we already know (epoch) is a no-op —
+        replays and races must not resurrect a previous owner."""
         with self._lock:
             shard, owner = int(shard), int(owner)
             if n_members is not None:
                 self.n_members = int(n_members)
+            if epoch is not None and int(epoch) < self.shard_epoch.get(
+                    shard, 0):
+                return True  # stale replay of an older move
             self.shard_map[shard] = owner
+            if epoch is not None:
+                self.shard_epoch[shard] = int(epoch)
             if owner != self.member_id:
                 self.shards = self.shards - {shard}
             self._prep_append({"ev": "own", "txid": 0, "shard": shard,
-                               "owner": owner})
+                               "owner": owner,
+                               "epoch": int(self.shard_epoch.get(shard, 0))})
         return True
 
     def m_export_shard(self, shard: int, target: int) -> bytes:
-        """Package + relinquish one shard for a live move.
+        """Phase 1 of a live shard move: package a COPY of the shard.
 
         Refuses (retryably) while any staged txn or chain hole touches
         the shard — the prepare→commit window pins ownership, so a
         coordinator never has to chase a staged txn across members.
-        After this returns, the shard's data exists ONLY in the returned
-        package until the target imports it: the driver must not drop
-        the bytes on the floor (crash recovery: the source's WAL still
-        holds the records until drop, and drop happens here — so the
-        DRIVER retries the import, never the export)."""
+
+        The move is TWO-PHASE (riak_core handoff keeps the source vnode
+        until the receiver acks the fold for the same reason): export
+        copies without dropping and marks the shard mid-move — new
+        prepares get retryable "busy" refusals so the package cannot go
+        stale — and only the separate :meth:`m_relinquish_shard` (called
+        by the driver AFTER the target confirmed the import) drops the
+        source copy and durably flips ownership.  A driver crash between
+        export and import therefore destroys nothing: the source still
+        owns the only live copy, and :meth:`m_cancel_export` (or a
+        member restart — the mid-move mark is deliberately volatile)
+        reopens the shard for writes."""
         from antidote_tpu.store import handoff as _handoff
 
         shard, target = int(shard), int(target)
         with self._lock:
-            self._check_owner(shard)
+            if shard not in self.shards:
+                # NOT _check_owner: a shard mid-move is still owned here,
+                # and a driver retry may legitimately re-export it (the
+                # mid-move write block keeps the package contents stable)
+                raise RuntimeError(
+                    f"not_owner: shard {shard} owner "
+                    f"{self.shard_map.get(shard, -1)}"
+                )
             for txid, st in self.staged.items():
                 effects = st[0]
                 for eff in effects:
@@ -896,16 +958,48 @@ class ClusterMember:
                 raise RuntimeError(f"busy: chain holes on shard {shard}")
             pkg = _handoff.export_shard(self.node.store, shard)
             pkg["applied_ts"] = int(self.applied_ts.get(shard, 0))
+            # the epoch this move WILL have once it completes: importers
+            # adopt it, and the relinquish/broadcast carry it so stale
+            # pre-move map entries can never clobber the new owner
+            pkg["owner_epoch"] = int(self.shard_epoch.get(shard, 0)) + 1
             data = _handoff.pack(pkg)
+            self.moving.add(shard)
+        return data
+
+    def m_relinquish_shard(self, shard: int, target: int) -> int:
+        """Phase 2 of a live shard move: the driver confirmed the import
+        landed at ``target`` — drop the source copy and durably record
+        the new owner.  Idempotent: a repeat for an already-relinquished
+        shard is a no-op (driver retries after transient RPC errors).
+        Returns the move's ownership epoch for the driver's broadcast."""
+        from antidote_tpu.store import handoff as _handoff
+
+        shard, target = int(shard), int(target)
+        with self._lock:
+            self.moving.discard(shard)
+            if shard not in self.shards:
+                # duplicate relinquish after a driver retry
+                return int(self.shard_epoch.get(shard, 0))
             _handoff.drop_shard(self.node.store, shard)
             # copy-on-write: lock-free readers iterate the old set
             self.shards = self.shards - {shard}
             self.shard_map[shard] = target
+            epoch = int(self.shard_epoch.get(shard, 0)) + 1
+            self.shard_epoch[shard] = epoch
             self.applied_ts.pop(shard, None)
             self.chain_wait.pop(shard, None)
             self._prep_append({"ev": "own", "txid": 0, "shard": shard,
-                               "owner": target})
-        return data
+                               "owner": target, "epoch": epoch})
+        return epoch
+
+    def m_cancel_export(self, shard: int) -> bool:
+        """Abort phase 1: the import failed for good (or the driver is
+        cleaning up after a predecessor's crash) — reopen the shard for
+        writes.  The exported package is simply forgotten; nothing was
+        dropped."""
+        with self._lock:
+            self.moving.discard(int(shard))
+        return True
 
     def m_import_shard(self, data: bytes) -> bool:
         """Install a moved shard and take ownership (idempotent: a
@@ -920,6 +1014,8 @@ class ClusterMember:
             self.node.receive_handoff(pkg)
             self.shards = self.shards | {shard}
             self.shard_map[shard] = self.member_id
+            self.shard_epoch[shard] = int(pkg.get(
+                "owner_epoch", self.shard_epoch.get(shard, 0) + 1))
             self.applied_ts[shard] = int(pkg.get("applied_ts", 0))
             self.chain_wait[shard] = {}
             # certification continuity for the moved keys (the member
@@ -933,7 +1029,8 @@ class ClusterMember:
                     self.last_commit[dk] = max(
                         self.last_commit.get(dk, 0), lane)
             self._prep_append({"ev": "own", "txid": 0, "shard": shard,
-                               "owner": self.member_id})
+                               "owner": self.member_id,
+                               "epoch": int(self.shard_epoch[shard])})
         return True
 
     def m_prepare(self, txid: int, effs_wire: list, snap_own: int) -> bool:

@@ -14,9 +14,12 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Callable, Dict
+import time
+from typing import Any, Callable, Dict, Optional
 import msgpack
 import numpy as np
+
+from antidote_tpu import faults
 
 log = logging.getLogger(__name__)
 
@@ -25,6 +28,12 @@ _HDR = struct.Struct(">I")
 
 class RpcError(RuntimeError):
     """The remote handler raised; carries the remote repr."""
+
+
+class RpcTimeout(RpcError):
+    """The call exhausted its deadline/retry budget.  Distinct from
+    RpcError (remote raised): the remote MAY have executed the request —
+    callers retry only idempotent methods after this."""
 
 
 def _send(sock: socket.socket, obj: Any) -> None:
@@ -62,9 +71,22 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.handlers: Dict[str, Callable] = {}
+        self._bind_host = host
+        #: live handler connections — close() must sever these, or a
+        #: "killed" server keeps answering through parked threads
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         srv_self = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with srv_self._conns_lock:
+                    srv_self._conns.add(self.request)
+
+            def finish(self):
+                with srv_self._conns_lock:
+                    srv_self._conns.discard(self.request)
+
             def handle(self):
                 while True:
                     try:
@@ -99,8 +121,16 @@ class RpcServer:
             daemon_threads = True
             allow_reuse_address = True
 
+        self._server_cls, self._handler_cls = Server, Handler
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
+        self._serve()
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.register_endpoint(f"rpc.server.{self.port}",
+                                  kill=self.close, restart=self.restart)
+
+    def _serve(self) -> None:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"cluster-rpc:{self.port}",
@@ -113,43 +143,153 @@ class RpcServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            # shutdown THEN close: a bare close on a socket another
+            # thread is recv()-blocked on never sends the FIN, so
+            # clients would keep talking to a "dead" server
+            for c in list(self._conns):
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    def restart(self) -> None:
+        """Rebind on the SAME port with the same handler table — the
+        member-crash-and-rejoin path chaos tests drive; clients retry
+        into the reborn server transparently."""
+        self._server = self._server_cls((self._bind_host, self.port),
+                                        self._handler_cls)
+        self._serve()
 
 
 class RpcClient:
-    """One connection per calling thread; calls are synchronous."""
+    """One connection per calling thread; calls are synchronous.
 
-    def __init__(self, host: str, port: int):
+    Every call carries a DEADLINE (per-attempt socket timeout) and a
+    bounded retry budget with exponential backoff on transport errors —
+    the disterl stand-in must not hang a coordinator forever on a dead
+    member, and must ride out a member restart (riak_core handoff
+    retries play the same role in the reference).  A reply timeout
+    surfaces as :class:`RpcTimeout` WITHOUT a blind resend: the remote
+    may have executed the request; only the caller knows whether the
+    method is idempotent."""
+
+    #: per-attempt deadline (s); generous — it bounds hangs, not latency
+    DEFAULT_TIMEOUT_S = 30.0
+    #: transport-error redials per call (server restarts mid-stream)
+    DEFAULT_RETRIES = 3
+
+    def __init__(self, host: str, port: int,
+                 timeout: Optional[float] = DEFAULT_TIMEOUT_S,
+                 retries: int = DEFAULT_RETRIES,
+                 backoff_base: float = 0.05):
         self.addr = (host, port)
+        self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.backoff_base = backoff_base
         self._local = threading.local()
 
     def _sock(self) -> socket.socket:
         s = getattr(self._local, "sock", None)
         if s is None:
-            s = socket.create_connection(self.addr)
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.settimeout(self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._local.sock = s
         return s
 
+    def _drop_sock(self) -> None:
+        s = getattr(self._local, "sock", None)
+        self._local.sock = None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
     def call(self, method: str, *args) -> Any:
-        s = self._sock()
-        try:
-            _send(s, {"m": method, "a": list(args)})
-            reply = _recv(s)
-        except (ConnectionError, OSError):
-            # one reconnect: the server may have restarted between calls
-            self._local.sock = None
-            s = self._sock()
-            _send(s, {"m": method, "a": list(args)})
-            reply = _recv(s)
-        if "err" in reply:
-            raise RpcError(reply["err"])
-        return reply["ok"]
+        d = faults.hit("rpc.call", key=method)
+        if d is not None:
+            if d.action == "delay" and d.arg:
+                time.sleep(float(d.arg))
+            elif d.action == "error":
+                raise RpcError(f"injected fault: rpc.call {method}")
+            elif d.action == "drop":
+                # a lost request/reply: this call FAILS the way a real
+                # drop does once the deadline fires
+                self._drop_sock()
+                _net_deadline()
+                raise RpcTimeout(
+                    f"injected drop: rpc.call {method} to {self.addr}")
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            if attempt:
+                _net_retry()
+                time.sleep(self.backoff_base * (2 ** (attempt - 1)))
+            try:
+                s = self._sock()
+                _send(s, {"m": method, "a": list(args)})
+            except (ConnectionError, OSError) as e:
+                # SEND failed: the request never reached the handler
+                # (typical after a server restart severs cached conns)
+                # — always safe to redial and resend within the budget
+                self._drop_sock()
+                last = e
+                continue
+            try:
+                reply = _recv(s)
+            except socket.timeout as e:
+                # the request may be EXECUTING remotely: resending could
+                # double-apply a non-idempotent method — surface instead
+                self._drop_sock()
+                _net_deadline()
+                raise RpcTimeout(
+                    f"{method} to {self.addr} exceeded "
+                    f"{self.timeout}s deadline") from e
+            except (ConnectionError, OSError) as e:
+                # the REPLY was lost after a complete send: the remote
+                # may have executed the request, so a blind resend could
+                # double-apply a non-idempotent method (e.g. a bcounter
+                # grant commit).  At-most-once: surface; only the caller
+                # knows whether its method is safe to retry.
+                self._drop_sock()
+                _net_deadline()
+                raise RpcTimeout(
+                    f"{method} to {self.addr}: connection died awaiting "
+                    "the reply (remote may have executed)") from e
+            if "err" in reply:
+                raise RpcError(reply["err"])
+            return reply["ok"]
+        _net_deadline()
+        raise RpcTimeout(
+            f"{method} to {self.addr} failed after {self.retries} "
+            f"attempt(s)") from last
 
     def close(self) -> None:
-        s = getattr(self._local, "sock", None)
-        if s is not None:
-            s.close()
-            self._local.sock = None
+        self._drop_sock()
+
+
+def _net_retry() -> None:
+    try:
+        from antidote_tpu.obs.metrics import net_metrics
+
+        net_metrics().rpc_retries.inc()
+    except Exception:
+        pass
+
+
+def _net_deadline() -> None:
+    try:
+        from antidote_tpu.obs.metrics import net_metrics
+
+        net_metrics().rpc_deadline_exceeded.inc()
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -88,12 +88,11 @@ def cmd_serve(args) -> int:
     from antidote_tpu.supervise import Supervisor
 
     interdc = None
+    fabric = None
     if args.interdc:
         # geo-replication plane: a TCP fabric + DCReplica so protocol
         # clients can bootstrap a DC mesh (GetConnectionDescriptor /
         # ConnectToDCs on either dialect)
-        import threading
-
         from antidote_tpu.interdc import DCReplica
         from antidote_tpu.interdc.tcp import TcpFabric
 
@@ -109,18 +108,22 @@ def cmd_serve(args) -> int:
         interdc = DCReplica(node, fabric, name=f"dc{args.dc_id}")
         if recover:
             interdc.restore_from_log()
-
-        def _pump():
-            while True:
-                try:
-                    fabric.pump(timeout=0.2)
-                except Exception as e:
-                    log(f"interdc pump error: {e!r}")
-                time.sleep(0.01)
-
-        threading.Thread(target=_pump, daemon=True,
-                         name="interdc-pump").start()
     sup = Supervisor(on_giveup=lambda name: os._exit(70))
+    if fabric is not None:
+        # the replication drain loop runs as a SUPERVISED child: a pump
+        # crash (bad frame, handler bug) restarts the loop instead of
+        # silently freezing geo-replication while the node keeps serving
+        # (the r5 advisor's "threads die silently" failure mode)
+        from antidote_tpu.supervise import ThreadLoop
+
+        sup.add(
+            "interdc-pump",
+            start=lambda: ThreadLoop(
+                lambda: fabric.pump(timeout=0.2), interval_s=0.01,
+                name="interdc-pump").start(),
+            alive=lambda lp: lp.is_alive(),
+            stop=lambda lp: lp.stop(),
+        )
     server_box = {}
 
     def start_proto():

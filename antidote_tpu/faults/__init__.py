@@ -1,0 +1,45 @@
+"""Deterministic fault injection for the self-healing fabric.
+
+The OTP reference earns its resilience claims with supervision trees and
+riak_core handoff retries; this package earns ours with seeded chaos: a
+:class:`FaultPlan` declares which messages die, stutter, rot, or stall at
+named injection sites threaded through the inter-DC fabric
+(``interdc/tcp.py``), the cluster RPC plane (``cluster/rpc.py``), the WAL
+(``log/wal.py``), and the native pump load path
+(``interdc/native_pump.py``).  ``tests/test_chaos.py`` drives the plans
+and asserts the invariant that matters: after faults heal, every DC
+converges to identical materialized snapshots with zero lost effects.
+
+Usage::
+
+    from antidote_tpu import faults
+
+    plan = faults.FaultPlan(seed=42)
+    plan.drop("interdc.deliver", key=(0, 1), p=0.3)   # lossy link 0->1
+    inj = faults.install(plan)
+    inj.sever(0, 1)       # full partition (stream + query channel)
+    ...
+    inj.heal_all()
+    faults.uninstall()    # disarm; sites return to zero-overhead no-ops
+
+Sites pay one module-global read when no plan is armed, so production
+paths are unaffected.
+"""
+
+from antidote_tpu.faults.plan import (
+    ACTIONS,
+    Decision,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    get_injector,
+    hit,
+    install,
+    is_severed,
+    uninstall,
+)
+
+__all__ = [
+    "ACTIONS", "Decision", "FaultInjector", "FaultPlan", "FaultRule",
+    "get_injector", "hit", "install", "is_severed", "uninstall",
+]

@@ -1,0 +1,257 @@
+"""Seeded fault plans + the process-wide injector.
+
+The deterministic core of the chaos layer (see package docstring in
+``__init__.py``): a :class:`FaultPlan` is a declarative, seeded list of
+rules; :func:`install` arms it as the process-wide
+:class:`FaultInjector` that instrumented sites consult.  All decisions
+draw from one ``random.Random(seed)`` under a lock, so a given plan +
+a deterministic delivery order (the single pump thread) reproduces the
+same fault sequence run after run.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: actions a rule may take at a site; sites interpret them locally:
+#:   drop     — discard the message / skip the operation
+#:   dup      — deliver the message twice (chain logic must dedupe)
+#:   delay    — defer the message one delivery round / sleep arg seconds
+#:   truncate — corrupt the frame to its first ``arg`` bytes
+#:   error    — raise (ConnectionError at transports, IOError at the WAL)
+ACTIONS = ("drop", "dup", "delay", "truncate", "error")
+
+
+class Decision:
+    """What a site should do for one hit: ``action`` + optional arg."""
+
+    __slots__ = ("action", "arg", "site")
+
+    def __init__(self, action: str, arg: Any = None, site: str = ""):
+        self.action = action
+        self.arg = arg
+        self.site = site
+
+    def __repr__(self):
+        return f"Decision({self.action!r}, arg={self.arg!r}, site={self.site!r})"
+
+
+class FaultRule:
+    """One match+action rule.  ``key=None`` matches every key at the
+    site; ``p`` is the per-hit firing probability; ``times`` bounds the
+    total number of firings (None = unlimited)."""
+
+    __slots__ = ("site", "action", "key", "p", "times", "arg", "fired")
+
+    def __init__(self, site: str, action: str, key=None, p: float = 1.0,
+                 times: Optional[int] = None, arg: Any = None):
+        assert action in ACTIONS, action
+        self.site = site
+        self.action = action
+        self.key = key
+        self.p = float(p)
+        self.times = times
+        self.arg = arg
+        self.fired = 0
+
+    def matches(self, site: str, key) -> bool:
+        if site != self.site:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.key is None or self.key == key
+
+    def __repr__(self):
+        return (f"FaultRule({self.site!r}, {self.action!r}, key={self.key!r},"
+                f" p={self.p}, times={self.times}, fired={self.fired})")
+
+
+class FaultPlan:
+    """A seeded, declarative set of fault rules.
+
+        plan = FaultPlan(seed=7)
+        plan.drop("interdc.deliver", key=(0, 1), p=0.3)
+        plan.dup("interdc.deliver", p=0.1, times=5)
+        plan.error("wal.append", times=1)
+        inj = faults.install(plan)
+
+    Known sites (grep for ``faults.hit``):
+
+    ==================  =============================  =================
+    site                key                            planes
+    ==================  =============================  =================
+    interdc.deliver     (publisher_dc, subscriber_dc)  TcpFabric streams
+    interdc.rpc         (src_dc, target_dc)            log catch-up + query
+    rpc.call            method name                    intra-DC cluster RPC
+    wal.append          WAL file basename              durable log
+    native_pump.load    None                           native receive plane
+    ==================  =============================  =================
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = []
+
+    def add(self, site: str, action: str, key=None, p: float = 1.0,
+            times: Optional[int] = None, arg: Any = None) -> "FaultPlan":
+        self.rules.append(FaultRule(site, action, key, p, times, arg))
+        return self
+
+    # -- conveniences ---------------------------------------------------
+    def drop(self, site: str, key=None, p: float = 1.0,
+             times: Optional[int] = None) -> "FaultPlan":
+        return self.add(site, "drop", key, p, times)
+
+    def dup(self, site: str, key=None, p: float = 1.0,
+            times: Optional[int] = None) -> "FaultPlan":
+        return self.add(site, "dup", key, p, times)
+
+    def delay(self, site: str, key=None, p: float = 1.0,
+              times: Optional[int] = None, seconds: float = 0.0) -> "FaultPlan":
+        return self.add(site, "delay", key, p, times, arg=seconds)
+
+    def truncate(self, site: str, key=None, p: float = 1.0,
+                 times: Optional[int] = None, keep: int = 4) -> "FaultPlan":
+        return self.add(site, "truncate", key, p, times, arg=keep)
+
+    def error(self, site: str, key=None, p: float = 1.0,
+              times: Optional[int] = None, message: str = "injected fault"
+              ) -> "FaultPlan":
+        return self.add(site, "error", key, p, times, arg=message)
+
+
+class FaultInjector:
+    """The armed form of a plan: holds the seeded RNG, live partition
+    state, per-(site, action) hit counters, and the named kill/restart
+    registry for endpoints (fabric listeners, RPC servers)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.rng = random.Random(plan.seed)
+        self.rules = list(plan.rules)
+        self.counts: Dict[Tuple[str, str], int] = {}
+        #: severed link pairs, stored unordered (a partition cuts both
+        #: the stream and the query channel in both directions)
+        self._severed: set = set()
+        #: name -> (kill_fn, restart_fn) for registered endpoints
+        self._endpoints: Dict[str, Tuple[Callable, Callable]] = {}
+        self._lock = threading.Lock()
+
+    # -- rule evaluation ------------------------------------------------
+    def hit(self, site: str, key=None) -> Optional[Decision]:
+        """Evaluate the site against the plan; None means proceed
+        normally.  The FIRST matching rule that fires wins."""
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(site, key):
+                    continue
+                if rule.p < 1.0 and self.rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                ck = (site, rule.action)
+                self.counts[ck] = self.counts.get(ck, 0) + 1
+                self._count_metric(site, rule.action)
+                return Decision(rule.action, rule.arg, site)
+        return None
+
+    def _count_metric(self, site: str, action: str) -> None:
+        try:
+            from antidote_tpu.obs.metrics import net_metrics
+
+            net_metrics().faults_injected.inc(site=site, action=action)
+        except Exception:  # metrics must never break injection
+            pass
+
+    def fired(self, site: str, action: Optional[str] = None) -> int:
+        """Total decisions taken at a site (optionally one action)."""
+        with self._lock:
+            return sum(n for (s, a), n in self.counts.items()
+                       if s == site and (action is None or a == action))
+
+    # -- partitions -----------------------------------------------------
+    def sever(self, a: int, b: int) -> None:
+        """Cut the link between two DCs (both directions, both the
+        stream and the query channel)."""
+        with self._lock:
+            self._severed.add(frozenset((a, b)))
+        log.info("faults: severed link %s <-> %s", a, b)
+
+    def heal(self, a: int, b: int) -> None:
+        with self._lock:
+            self._severed.discard(frozenset((a, b)))
+        log.info("faults: healed link %s <-> %s", a, b)
+
+    def heal_all(self) -> None:
+        with self._lock:
+            self._severed.clear()
+        log.info("faults: all links healed")
+
+    def is_severed(self, a, b) -> bool:
+        if not self._severed:
+            return False
+        return frozenset((a, b)) in self._severed
+
+    # -- endpoint kill/restart -----------------------------------------
+    def register_endpoint(self, name: str, kill: Callable[[], None],
+                          restart: Callable[[], None]) -> None:
+        """Transports self-register their listeners here so chaos
+        drivers can crash and revive them by name."""
+        with self._lock:
+            self._endpoints[name] = (kill, restart)
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._endpoints)
+
+    def kill(self, name: str) -> None:
+        kill, _ = self._endpoints[name]
+        log.info("faults: killing endpoint %r", name)
+        kill()
+
+    def restart(self, name: str) -> None:
+        _, restart = self._endpoints[name]
+        log.info("faults: restarting endpoint %r", name)
+        restart()
+
+
+# ---------------------------------------------------------------------------
+# process-wide installation
+# ---------------------------------------------------------------------------
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(plan: FaultPlan) -> FaultInjector:
+    """Arm a plan process-wide; returns the injector (also reachable via
+    :func:`get_injector`).  Replaces any previously installed plan."""
+    global _ACTIVE
+    _ACTIVE = FaultInjector(plan)
+    return _ACTIVE
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def hit(site: str, key=None) -> Optional[Decision]:
+    """Site-side fast path: one global read when no plan is armed."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.hit(site, key)
+
+
+def is_severed(a, b) -> bool:
+    inj = _ACTIVE
+    if inj is None:
+        return False
+    return inj.is_severed(a, b)

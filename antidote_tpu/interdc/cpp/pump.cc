@@ -65,11 +65,32 @@ struct Pump {
     void loop();
 };
 
+// Dropping a connection (EOF, read error, corrupt frame) queues a
+// sentinel frame (kind=0, empty payload) carrying the subscription tag,
+// so Python LEARNS of the drop and can log + resubscribe with backoff —
+// a silent close would permanently stall replication from that
+// publisher (the failure mode the Python reader threads never had).
+constexpr uint8_t K_CONN_DROP = 0;
+
 void close_conn(Pump* p, int fd) {
     epoll_ctl(p->epfd, EPOLL_CTL_DEL, fd, nullptr);
     ::close(fd);
-    std::lock_guard<std::mutex> g(p->mu);
-    p->conns.erase(fd);
+    long tag = -1;
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        auto it = p->conns.find(fd);
+        if (it != p->conns.end()) {
+            tag = it->second.tag;
+            p->conns.erase(it);
+        }
+        if (tag >= 0) {
+            Frame f;
+            f.tag = tag;
+            f.kind = K_CONN_DROP;
+            p->queue.push_back(std::move(f));
+        }
+    }
+    if (tag >= 0) p->cv.notify_one();
 }
 
 // parse complete frames out of c.buf, push to queue

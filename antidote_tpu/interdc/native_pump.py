@@ -10,10 +10,31 @@ failure falls back to the per-subscription Python reader threads.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import pathlib
 import subprocess
 from typing import Optional, Tuple
+
+from antidote_tpu import faults
+
+log = logging.getLogger(__name__)
+
+
+def _fallback(reason: Optional[str]) -> None:
+    """Count + log a native-plane fallback; returns None (the create()
+    contract for "use the Python readers")."""
+    if reason is not None:
+        log.warning("native pump unavailable (%s); falling back to "
+                    "Python reader threads", reason)
+    try:
+        from antidote_tpu.obs.metrics import net_metrics
+
+        net_metrics().pump_fallback.inc()
+    except Exception:
+        pass
+    return None
+
 
 _DIR = pathlib.Path(__file__).parent / "cpp"
 _SRC = _DIR / "pump.cc"
@@ -73,12 +94,29 @@ class NativePump:
         self._buf = ctypes.create_string_buffer(1 << 20)
         self._descs = (ctypes.c_long * (3 * self._BATCH))()
 
+    #: frame kind queued by the native loop when a subscription socket
+    #: drops (EOF/read error/corrupt frame) — carries the tag, empty
+    #: payload.  The fabric resubscribes with backoff on seeing it.
+    K_CONN_DROP = 0
+
     @staticmethod
     def create() -> Optional["NativePump"]:
         if os.environ.get("ANTIDOTE_NATIVE_PUMP", "on") == "off":
             return None
+        if faults.hit("native_pump.load") is not None:
+            return _fallback(None)  # injected load failure (chaos tests)
         lib = _load_lib()
-        return NativePump(lib) if lib is not None else None
+        if lib is None:
+            return _fallback("compile/load failed")
+        p = NativePump(lib)
+        if p._h is None:
+            # pump_new() failed (NULL → ctypes None — fd exhaustion or a
+            # blocked epoll/eventfd syscall).  A pump with no epoll loop
+            # would close every detached fd handed to add(), silently
+            # blackholing each subscription; report the failure so
+            # TcpFabric.subscribe keeps the Python reader threads.
+            return _fallback("pump_new returned NULL")
+        return p
 
     def add(self, fd: int, tag: int) -> None:
         """Register a connected socket fd; the pump OWNS it from here

@@ -21,10 +21,13 @@ from __future__ import annotations
 
 import collections
 import itertools
+import logging
 import time
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.interdc.messages import Descriptor, TxnMessage
@@ -206,7 +209,10 @@ class DCReplica:
         transport endpoint when the hub has one (TcpFabric), so another
         process/deployment can subscribe from the descriptor alone."""
         addr = None
-        address_of = getattr(self.hub, "address_of", None)
+        # exported descriptors carry the ADVERTISED address (public_host
+        # substituted); local dialing keeps using the bind address
+        address_of = getattr(self.hub, "advertised_of",
+                             getattr(self.hub, "address_of", None))
         if address_of is not None:
             try:
                 addr = tuple(address_of(self.fabric_id))
@@ -422,7 +428,19 @@ class DCReplica:
     # ingress
     # ------------------------------------------------------------------
     def _on_message(self, data: bytes) -> None:
-        msg = TxnMessage.from_bytes(data)
+        try:
+            msg = TxnMessage.from_bytes(data)
+        except Exception:
+            # a frame corrupted in transit (truncation, bit rot) must not
+            # kill the pump: discard it — if it carried a txn, the chain
+            # gap surfaces on the next message and catch-up replays it
+            # from the publisher's log
+            from antidote_tpu.obs.metrics import net_metrics
+
+            net_metrics().corrupt_frames.inc()
+            log.warning("discarding undecodable inter-DC frame (%d bytes)",
+                        len(data))
+            return
         if msg.origin == self.dc_id or msg.shard not in self.shards:
             return
         key = (msg.origin, msg.shard)
@@ -446,7 +464,20 @@ class DCReplica:
     def _catch_up(self, key, from_opid) -> None:
         origin, shard = key
         target = self.route_query(origin, shard)
-        for data in self.hub.query_log(target, shard, origin, from_opid):
+        try:
+            msgs = self.hub.query_log(target, shard, origin, from_opid)
+        except (ConnectionError, OSError) as e:
+            # the query channel is down (partition, endpoint restart):
+            # keep the out-of-order buffer and return — every later ping
+            # on this chain re-reveals the gap and retries the catch-up,
+            # so healing the link heals the chain with no operator action
+            from antidote_tpu.obs.metrics import net_metrics
+
+            net_metrics().catchup_failures.inc()
+            log.warning("catch-up query to dc%s for chain %s failed (%r); "
+                        "will retry on the next chain message", target, key, e)
+            return
+        for data in msgs:
             m = TxnMessage.from_bytes(data)
             if not m.is_ping and m.prev_opid == self.last_seen.get(key, 0):
                 self._accept(key, m)
@@ -493,7 +524,23 @@ class DCReplica:
         after the whole batch applied (the stable snapshot must never
         dominate unapplied ops — including ping advances, which are
         deferred the same way so a ping queued behind a txn cannot claim
-        its ts early)."""
+        its ts early).
+
+        SERIALIZATION: the whole drain runs under the transaction
+        manager's commit lock.  A server-thread commit applies effects
+        via the same ``KVStore.apply_effects`` read-modify-reassign of
+        the device tables (``t.ops_a = t.ops_a.at[...].set(...)``); two
+        concurrent appliers can silently drop a whole batch, and the
+        chain-clock duplicate suppression then makes the loss permanent
+        (r5 advisor high).  The lock is reentrant and taken in the same
+        order everywhere (endpoint handler lock → commit lock), so the
+        remote-ingress plane and the local-commit plane are mutually
+        exclusive writers, mirroring how bcounter grants are already
+        excluded via the endpoint lock."""
+        with self.node.txm.commit_lock:
+            self._drain_gates_locked()
+
+    def _drain_gates_locked(self) -> None:
         store = self.node.store
         while True:
             sim = store.applied_vc.copy()

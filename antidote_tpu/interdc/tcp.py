@@ -19,7 +19,9 @@ gave the reference).
 from __future__ import annotations
 
 import collections
+import logging
 import queue
+import random
 import socket
 import socketserver
 import time
@@ -29,8 +31,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from antidote_tpu import faults
+from antidote_tpu.obs.metrics import net_metrics
+
+log = logging.getLogger(__name__)
+
 _HDR = struct.Struct(">IB")
 K_SUB, K_PUSH, K_LOGQ, K_REQ, K_REPLY, K_ERR = 1, 2, 3, 4, 5, 6
+#: native-pump sentinel (pump.cc K_CONN_DROP): a subscription stream
+#: died; resubscribe instead of delivering
+K_CONN_DROP = 0
 
 
 def _send(sock, kind: int, body) -> None:
@@ -55,6 +65,22 @@ def _read_exact(sock, n: int) -> bytes:
     return bytes(buf)
 
 
+def _hard_close(sock) -> None:
+    """shutdown + close.  A bare close() while ANOTHER thread is parked
+    in recv() on the socket never sends the FIN — the in-flight syscall
+    keeps the kernel file alive — so the peer would never learn the
+    connection died.  shutdown() tears the stream down immediately and
+    wakes the parked reader."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 class _Endpoint:
     """One DC's listening side: accepts subscriber streams and queries."""
 
@@ -68,6 +94,10 @@ class _Endpoint:
         #: frames on one stream; _subs_lock guards only list membership
         self._subs: List[Tuple[socket.socket, threading.Lock]] = []
         self._subs_lock = threading.Lock()
+        #: live query/request connections (server side): close() must
+        #: shut these down too, or a killed endpoint would keep serving
+        #: RPCs through parked handler threads
+        self._queries: set = set()
         ep = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -114,19 +144,25 @@ class _Endpoint:
                             ep._subs.remove(entry)
                     return
                 # query connection: serve request/reply until EOF
-                while True:
-                    try:
-                        reply = ep._serve(kind, body)
-                        _send(self.request, K_REPLY, reply)
-                        kind, body = _recv(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    except Exception as e:
+                with ep._subs_lock:
+                    ep._queries.add(self.request)
+                try:
+                    while True:
                         try:
-                            _send(self.request, K_ERR, repr(e))
+                            reply = ep._serve(kind, body)
+                            _send(self.request, K_REPLY, reply)
                             kind, body = _recv(self.request)
                         except (ConnectionError, OSError):
                             return
+                        except Exception as e:
+                            try:
+                                _send(self.request, K_ERR, repr(e))
+                                kind, body = _recv(self.request)
+                            except (ConnectionError, OSError):
+                                return
+                finally:
+                    with ep._subs_lock:
+                        ep._queries.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -177,12 +213,15 @@ class _Endpoint:
         self._server.shutdown()
         self._server.server_close()
         with self._subs_lock:
+            # _hard_close, not close(): the park/serve threads are
+            # blocked in recv() on these sockets, and a bare close never
+            # sends the FIN that tells subscribers the stream died
             for c, _ in self._subs:
-                try:
-                    c.close()
-                except OSError:
-                    pass
+                _hard_close(c)
             self._subs.clear()
+            for c in list(self._queries):
+                _hard_close(c)
+            self._queries.clear()
 
 
 class TcpFabric:
@@ -196,7 +235,9 @@ class TcpFabric:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 public_host: Optional[str] = None):
+                 public_host: Optional[str] = None, reconnect: bool = True,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 max_reconnect_tries: Optional[int] = None):
         self.host = host
         #: fixed listen port for the FIRST registered endpoint (0 =
         #: ephemeral).  Deployments binding 0.0.0.0 need a publishable
@@ -205,16 +246,32 @@ class TcpFabric:
         self._bind_port = port
         #: address advertised in connection descriptors; a 0.0.0.0 bind
         #: address is meaningless to a REMOTE DC (it would connect to
-        #: itself), so operators set the reachable name here
+        #: itself), so operators set the reachable name here.  It is
+        #: substituted ONLY into exported Descriptors — local dialing
+        #: (in-process subscribe/_rpc) keeps the bind address, which an
+        #: external DNS/LB name may not hairpin back to
         self.public_host = public_host
+        #: severed subscriptions re-dial with jittered exponential
+        #: backoff in [backoff_base, backoff_max] seconds; None budget =
+        #: keep trying until the fabric closes (the riak_core stance:
+        #: links heal, processes don't give up on them)
+        self.reconnect = reconnect
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.max_reconnect_tries = max_reconnect_tries
         self.endpoints: Dict[int, _Endpoint] = {}
         #: dc_id -> tick callback (deferred-heartbeat flush at pump)
         self._ticks: Dict[int, Callable] = {}
-        #: dc_id -> (host, port) for remote DCs
+        #: dc_id -> (host, port) dialable FROM THIS PROCESS
         self.addresses: Dict[int, Tuple[str, int]] = {}
-        #: subscriber-side inbox: (on_message, data) pairs await pump()
+        #: dc_id -> (host, port) to put in exported descriptors
+        self.advertised: Dict[int, Tuple[str, int]] = {}
+        #: subscriber-side inbox: (deliver, data) pairs await pump()
         self.inbox: "queue.Queue" = queue.Queue()
         self._readers: List[threading.Thread] = []
+        self._closed = False
+        #: jitter source for reconnect backoff (NOT the fault plan's rng)
+        self._rng = random.Random()
         #: native receive plane (cpp/pump.cc): ONE epoll thread owns all
         #: subscription sockets — kernel reads + framing in C++ (the
         #: libzmq io-thread role, SURVEY §2.9); None = build/load
@@ -238,7 +295,18 @@ class TcpFabric:
         ep = _Endpoint(self, dc_id, self.host, port)
         ep.query_handler = query_handler
         self.endpoints[dc_id] = ep
-        self.addresses[dc_id] = (self.public_host or ep.host, ep.port)
+        # local dialing keeps the BIND address; public_host goes only
+        # into exported descriptors (advertised_of) — an external LB/DNS
+        # name may not resolve or hairpin from inside this process
+        self.addresses[dc_id] = (ep.host, ep.port)
+        self.advertised[dc_id] = (self.public_host or ep.host, ep.port)
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.register_endpoint(
+                f"interdc.ep.{dc_id}",
+                kill=lambda d=dc_id: self.kill_endpoint(d),
+                restart=lambda d=dc_id: self.restart_endpoint(d),
+            )
 
     def register_request(self, dc_id: int, handler) -> None:
         self.endpoints[dc_id].request_handler = handler
@@ -246,59 +314,252 @@ class TcpFabric:
     def address_of(self, dc_id: int) -> Tuple[str, int]:
         return self.addresses[dc_id]
 
+    def advertised_of(self, dc_id: int) -> Tuple[str, int]:
+        """The address to export in a Descriptor (public_host for local
+        endpoints, the learned address for remote ones)."""
+        return self.advertised.get(dc_id, self.addresses[dc_id])
+
     def connect_remote(self, dc_id: int, host: str, port: int) -> None:
         """Learn a remote (possibly other-process) DC's endpoint."""
         self.addresses[dc_id] = (host, port)
+        self.advertised[dc_id] = (host, port)
 
+    # -- endpoint crash/revive (chaos drivers + operators) --------------
+    def kill_endpoint(self, dc_id: int) -> None:
+        """Crash one DC's listening endpoint: the server and every
+        subscriber stream die, exactly what a process kill does to the
+        socket layer.  Peers' reconnect loops take over from here."""
+        self.endpoints[dc_id].close()
+
+    def restart_endpoint(self, dc_id: int) -> None:
+        """Rebind a killed endpoint on its old port with its old
+        handlers; reconnecting subscribers find it at the same address."""
+        old = self.endpoints[dc_id]
+        ep = _Endpoint(self, dc_id, self.host, old.port)
+        ep.query_handler = old.query_handler
+        ep.request_handler = old.request_handler
+        self.endpoints[dc_id] = ep
+        self.addresses[dc_id] = (ep.host, ep.port)
+        self.advertised[dc_id] = (self.public_host or ep.host, ep.port)
+
+    # -- subscriptions ---------------------------------------------------
     def subscribe(self, subscriber_dc: int, publisher_dc: int,
                   on_message) -> None:
+        deliver = self._make_deliver(publisher_dc, subscriber_dc,
+                                     on_message)
+        sock = self._dial_sub(subscriber_dc, publisher_dc)
+        self._attach(sock, subscriber_dc, publisher_dc, deliver)
+
+    def _dial_sub(self, subscriber_dc: int, publisher_dc: int,
+                  timeout: float = 5.0) -> socket.socket:
+        """Open one subscription stream: connect, K_SUB, await the ack.
+        Raises ConnectionError/OSError on any failure (reconnect loops
+        catch and back off)."""
         host, port = self.addresses[publisher_dc]
-        sock = socket.create_connection((host, port))
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send(sock, K_SUB, subscriber_dc)
-        # wait for the registration ack before handing the socket to the
-        # reader — subscribe() returning means the stream is live
-        kind, _ = _recv(sock)
-        assert kind == K_REPLY, kind
+        sock = socket.create_connection((host, port), timeout=timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send(sock, K_SUB, subscriber_dc)
+            # wait for the registration ack before handing the socket to
+            # the reader — subscribe() returning means the stream is live
+            kind, _ = _recv(sock)
+            if kind != K_REPLY:
+                raise ConnectionError(f"bad subscription ack kind {kind}")
+            sock.settimeout(None)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        return sock
+
+    def _attach(self, sock: socket.socket, subscriber_dc: int,
+                publisher_dc: int, deliver) -> None:
         if self._np is not None:
             # native plane: hand the raw fd to the epoll pump
             tag = self._np_next
             self._np_next += 1
-            self._np_tags[tag] = on_message
+            self._np_tags[tag] = (deliver, subscriber_dc, publisher_dc)
             self._np.add(sock.detach(), tag)
             return
+        t = threading.Thread(
+            target=self._reader_loop,
+            args=(sock, subscriber_dc, publisher_dc, deliver), daemon=True,
+            name=f"sub:{subscriber_dc}<-{publisher_dc}")
+        t.start()
+        self._readers.append(t)
 
-        def reader():
+    def _reader_loop(self, sock, subscriber_dc: int, publisher_dc: int,
+                     deliver) -> None:
+        """Python-plane reader: drain frames; on disconnect, re-dial
+        with backoff instead of dying (the stream heals, the opid-gap
+        catch-up replays whatever the outage lost)."""
+        while True:
             try:
                 while True:
                     kind, body = _recv(sock)
                     if kind == K_PUSH:
-                        self.inbox.put((on_message, bytes(body)))
+                        self.inbox.put((deliver, bytes(body)))
             except (ConnectionError, OSError):
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            sock = self._reconnect(subscriber_dc, publisher_dc)
+            if sock is None:
                 return
 
-        t = threading.Thread(target=reader, daemon=True,
-                             name=f"sub:{subscriber_dc}<-{publisher_dc}")
-        t.start()
-        self._readers.append(t)
+    def _reconnect(self, subscriber_dc: int,
+                   publisher_dc: int) -> Optional[socket.socket]:
+        """Re-dial a dropped subscription with jittered exponential
+        backoff.  Returns a live (acked) socket, or None when the fabric
+        closed / reconnect is disabled / the retry budget ran out.
+        Messages published during the outage are NOT lost: the replica's
+        chain-gap detection queries the publisher's log from the last
+        delivered opid once the stream is back."""
+        if not self.reconnect or self._closed:
+            return None
+        link = f"{publisher_dc}->{subscriber_dc}"
+        attempt = 0
+        while not self._closed:
+            if (self.max_reconnect_tries is not None
+                    and attempt >= self.max_reconnect_tries):
+                log.error("subscription %s: reconnect budget (%d) "
+                          "exhausted; stream stays down", link, attempt)
+                return None
+            delay = min(self.backoff_max,
+                        self.backoff_base * (2 ** min(attempt, 16)))
+            # full jitter in [0.5x, 1.5x): concurrent reconnects from
+            # many subscribers must not stampede a reborn endpoint
+            time.sleep(delay * (0.5 + self._rng.random()))
+            attempt += 1
+            net_metrics().reconnect_attempts.inc(link=link)
+            try:
+                sock = self._dial_sub(subscriber_dc, publisher_dc)
+            except (ConnectionError, OSError):
+                continue
+            net_metrics().reconnects.inc(link=link)
+            log.warning("subscription %s: reconnected after %d attempt(s)",
+                        link, attempt)
+            return sock
+        return None
+
+    def _make_deliver(self, publisher_dc: int, subscriber_dc: int, cb):
+        """Wrap a subscriber callback with the link's fault filter.
+        Runs on the pump (control) thread, so seeded decisions are
+        deterministic for a given delivery order."""
+
+        def deliver(data: bytes) -> None:
+            inj = faults.get_injector()
+            if inj is not None:
+                if inj.is_severed(publisher_dc, subscriber_dc):
+                    return
+                d = inj.hit("interdc.deliver",
+                            key=(publisher_dc, subscriber_dc))
+                if d is not None:
+                    if d.action == "drop":
+                        return
+                    if d.action == "delay":
+                        # redeliver in a later pump round (reordering);
+                        # the rule decides again on the retry
+                        self.inbox.put((deliver, data))
+                        return
+                    if d.action == "truncate":
+                        data = data[: int(d.arg or 4)]
+                    elif d.action == "dup":
+                        cb(data)
+                    elif d.action == "error":
+                        raise RuntimeError(
+                            "injected fault: interdc.deliver "
+                            f"{publisher_dc}->{subscriber_dc}")
+            cb(data)
+
+        return deliver
 
     def publish(self, from_dc: int, data: bytes) -> None:
         self.endpoints[from_dc].push(data)
 
+    #: per-attempt deadline for query-channel calls: a wedged peer must
+    #: not hang a catch-up forever (the reference's REQ sockets carry
+    #: ?COMM_TIMEOUT, /root/reference/include/antidote.hrl)
+    QUERY_TIMEOUT_S = 30.0
+
     def _rpc(self, target_dc: int, kind: int, body):
+        src = next(iter(self.endpoints), None)
+        inj = faults.get_injector()
+        if inj is not None:
+            if src is not None and inj.is_severed(src, target_dc):
+                raise ConnectionError(
+                    f"injected partition: dc{src} <-> dc{target_dc}")
+            d = inj.hit("interdc.rpc", key=(src, target_dc))
+            if d is not None:
+                if d.action == "delay" and d.arg:
+                    time.sleep(float(d.arg))
+                elif d.action in ("drop", "error"):
+                    raise ConnectionError(
+                        f"injected fault: interdc.rpc -> dc{target_dc}")
+        last: Optional[Exception] = None
+        for _attempt in range(2):
+            with self._query_lock:
+                key = (threading.get_ident(), target_dc)
+                sock = self._query_conns.get(key)
+                if sock is None:
+                    host, port = self.addresses[target_dc]
+                    try:
+                        sock = socket.create_connection(
+                            (host, port), timeout=self.QUERY_TIMEOUT_S)
+                    except OSError as e:
+                        last = e
+                        continue
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    self._query_conns[key] = sock
+            try:
+                _send(sock, kind, body)
+            except (ConnectionError, OSError) as e:
+                # SEND failed — a cached conn gone stale (the peer's
+                # endpoint was killed and reborn on the same port): the
+                # request never got out, so redialing and resending is
+                # always safe
+                self._drop_query_conn(key, sock)
+                last = e
+                net_metrics().rpc_retries.inc()
+                continue
+            try:
+                rkind, reply = _recv(sock)
+            except socket.timeout as e:
+                # reply deadline: the remote MAY be executing the request
+                # — re-sending could double-apply a state-mutating K_REQ
+                # (bcounter grants), so surface instead of retrying
+                self._drop_query_conn(key, sock)
+                raise ConnectionError(
+                    f"query to dc{target_dc} exceeded "
+                    f"{self.QUERY_TIMEOUT_S}s deadline") from e
+            except (ConnectionError, OSError) as e:
+                # reply LOST after a complete send: the remote may have
+                # executed a state-mutating K_REQ — at-most-once, no
+                # blind resend (K_LOGQ catch-up reads retry on the next
+                # chain ping instead)
+                self._drop_query_conn(key, sock)
+                raise ConnectionError(
+                    f"query to dc{target_dc}: connection died awaiting "
+                    "the reply (remote may have executed)") from e
+            if rkind == K_ERR:
+                raise RuntimeError(
+                    f"remote error from dc{target_dc}: {reply}")
+            return reply
+        raise ConnectionError(
+            f"query channel to dc{target_dc} failed") from last
+
+    def _drop_query_conn(self, key, sock) -> None:
         with self._query_lock:
-            key = (threading.get_ident(), target_dc)
-            sock = self._query_conns.get(key)
-            if sock is None:
-                host, port = self.addresses[target_dc]
-                sock = socket.create_connection((host, port))
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._query_conns[key] = sock
-        _send(sock, kind, body)
-        rkind, reply = _recv(sock)
-        if rkind == K_ERR:
-            raise RuntimeError(f"remote error from dc{target_dc}: {reply}")
-        return reply
+            self._query_conns.pop(key, None)
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def query_log(self, target_dc: int, shard: int, origin: int,
                   from_opid: int) -> List[bytes]:
@@ -371,30 +632,73 @@ class TcpFabric:
         return n
 
     def _get_message(self, timeout: float):
-        """Next (on_message, data) from the Python inbox or the native
+        """Next (deliver, data) from the Python inbox or the native
         pump, whichever has one first; raises queue.Empty on timeout.
         Native frames arrive in BATCHES (one ctypes crossing drains up
-        to 512) and carry the raw wire payload — unpack here."""
+        to 512) and carry the raw wire payload — unpack here.  A
+        K_CONN_DROP sentinel (kind 0, queued by pump.cc when a stream
+        dies) triggers an off-thread resubscribe instead of a
+        delivery."""
         if self._np is None:
             return self.inbox.get(timeout=timeout)
-        # native mode: the inbox is never fed (subscribe hands every fd
-        # to the pump), so block straight on the native queue
         if self._np_ready:
             return self._np_ready.popleft()
         deadline = time.monotonic() + timeout
         while True:
+            try:
+                # native mode feeds the inbox only with DELAYED
+                # redeliveries (fault filter) — drain those first
+                return self.inbox.get_nowait()
+            except queue.Empty:
+                pass
             rem = deadline - time.monotonic()
             wait_ms = max(1, int(rem * 1000)) if rem > 0 else 1
             for tag, kind, payload in self._np.take_batch(wait_ms):
-                cb = self._np_tags.get(tag)
-                if cb is not None and kind == K_PUSH:
+                ent = self._np_tags.get(tag)
+                if ent is None:
+                    continue
+                deliver, sub_dc, pub_dc = ent
+                if kind == K_CONN_DROP:
+                    self._on_native_drop(tag, sub_dc, pub_dc)
+                    continue
+                if kind == K_PUSH:
                     body = msgpack.unpackb(payload, raw=False,
                                            strict_map_key=False)
-                    self._np_ready.append((cb, bytes(body)))
+                    self._np_ready.append((deliver, bytes(body)))
             if self._np_ready:
                 return self._np_ready.popleft()
             if rem <= 0:
                 raise queue.Empty
+
+    def _on_native_drop(self, tag: int, subscriber_dc: int,
+                        publisher_dc: int) -> None:
+        """A native-plane subscription died (sentinel from pump.cc's
+        close path — EOF, read error, or an over-cap/corrupt frame).
+        Resubscribe off-thread with backoff; the epoll loop keeps
+        serving the other streams meanwhile."""
+        if self._closed or not self.reconnect:
+            self._np_tags.pop(tag, None)
+            return
+        log.warning("subscription %s->%s: native stream dropped; "
+                    "resubscribing", publisher_dc, subscriber_dc)
+
+        def resub():
+            sock = self._reconnect(subscriber_dc, publisher_dc)
+            if sock is None:
+                self._np_tags.pop(tag, None)
+                return
+            np_pump = self._np
+            if np_pump is not None:
+                np_pump.add(sock.detach(), tag)  # same tag: same deliver
+            else:  # fabric torn down while we were backing off
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=resub, daemon=True,
+                         name=f"resub:{subscriber_dc}<-{publisher_dc}"
+                         ).start()
 
     def _local_locks(self):
         """A context manager holding every local endpoint's handler lock."""
@@ -422,6 +726,7 @@ class TcpFabric:
                     a.addresses.setdefault(dc, addr)
 
     def close(self) -> None:
+        self._closed = True  # stops reconnect loops before sockets die
         if self._np is not None:
             self._np.close()
             self._np = None

@@ -17,10 +17,13 @@ import ctypes
 import os
 import struct
 import subprocess
+import time
 import zlib
 from pathlib import Path
 from typing import Iterator
 import msgpack
+
+from antidote_tpu import faults
 
 _MAGIC = 0xA17D07E1
 _HDR = struct.Struct("<III")
@@ -86,7 +89,22 @@ class ShardWAL:
     def native(self) -> bool:
         return self._h is not None
 
+    def _faulted_append(self) -> None:
+        """Fault site "wal.append" (key = file basename): error raises
+        IOError before anything hits the file — the caller sees exactly
+        what a full disk / dead device produces; delay sleeps in the
+        append path (a stalling volume)."""
+        d = faults.hit("wal.append", key=os.path.basename(self.path))
+        if d is None:
+            return
+        if d.action == "error":
+            raise IOError(f"injected fault: wal.append {self.path}: {d.arg}")
+        if d.action == "delay" and d.arg:
+            time.sleep(float(d.arg))
+
     def append(self, record: dict) -> None:
+        if faults.get_injector() is not None:
+            self._faulted_append()
         payload = msgpack.packb(record, use_bin_type=True)
         if self._h is not None:
             n = self._lib.wal_append(self._h, payload, len(payload))

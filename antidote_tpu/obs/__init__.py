@@ -12,8 +12,10 @@ from antidote_tpu.obs.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    NetMetrics,
     NodeMetrics,
     install_error_monitor,
+    net_metrics,
 )
 from antidote_tpu.obs.server import MetricsServer
 from antidote_tpu.obs.trace import Timer, trace_span
@@ -23,8 +25,10 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "NetMetrics",
     "NodeMetrics",
     "MetricsServer",
+    "net_metrics",
     "Timer",
     "install_error_monitor",
     "trace_span",

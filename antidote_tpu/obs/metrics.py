@@ -173,6 +173,88 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+class NetMetrics:
+    """Process-wide fabric/RPC resilience counters.
+
+    These live OUTSIDE any node's registry because their owners (the TCP
+    fabric's reconnect loops, the cluster RPC client, the fault
+    injector) have no node reference — yet operators need them on the
+    same ``/metrics`` page.  :func:`net_metrics` returns the process
+    singleton; ``NodeMetrics`` attaches the same counter objects into
+    every node registry, so each node's exposition includes them.
+    """
+
+    def __init__(self):
+        self.reconnects = Counter(
+            "antidote_interdc_reconnects_total",
+            "Successful inter-DC subscription reconnects", ("link",)
+        )
+        self.reconnect_attempts = Counter(
+            "antidote_interdc_reconnect_attempts_total",
+            "Inter-DC subscription reconnect dial attempts", ("link",)
+        )
+        self.corrupt_frames = Counter(
+            "antidote_interdc_corrupt_frames_total",
+            "Undecodable inter-DC stream frames discarded"
+        )
+        self.catchup_failures = Counter(
+            "antidote_interdc_catchup_failures_total",
+            "Log catch-up queries that failed transiently"
+        )
+        self.rpc_retries = Counter(
+            "antidote_rpc_retries_total",
+            "Cluster RPC attempts retried after a transport error"
+        )
+        self.rpc_deadline_exceeded = Counter(
+            "antidote_rpc_deadline_exceeded_total",
+            "Cluster RPC calls that exhausted their deadline/retry budget"
+        )
+        self.faults_injected = Counter(
+            "antidote_faults_injected_total",
+            "Fault-injection decisions taken", ("site", "action")
+        )
+        self.pump_fallback = Counter(
+            "antidote_native_pump_fallback_total",
+            "Times the native receive plane was unavailable and the "
+            "Python reader fallback engaged"
+        )
+
+    def all_metrics(self):
+        return (self.reconnects, self.reconnect_attempts,
+                self.corrupt_frames, self.catchup_failures,
+                self.rpc_retries, self.rpc_deadline_exceeded,
+                self.faults_injected, self.pump_fallback)
+
+    def attach(self, registry: "MetricsRegistry") -> None:
+        """Register the shared counter objects into a node registry so
+        they appear in that node's exposition (idempotent per registry)."""
+        for m in self.all_metrics():
+            try:
+                registry.register(m)
+            except ValueError:
+                pass  # already attached to this registry
+
+    def snapshot(self) -> Dict[str, float]:
+        """Label-summed counter values (the console's status command)."""
+        out: Dict[str, float] = {}
+        for m in self.all_metrics():
+            out[m.name] = sum(m._values.values()) if m._values else 0.0
+        return out
+
+
+_NET: Optional[NetMetrics] = None
+_NET_LOCK = threading.Lock()
+
+
+def net_metrics() -> NetMetrics:
+    global _NET
+    if _NET is None:
+        with _NET_LOCK:
+            if _NET is None:
+                _NET = NetMetrics()
+    return _NET
+
+
 class NodeMetrics:
     """The per-replica metric set, named as in the reference."""
 
@@ -204,6 +286,9 @@ class NodeMetrics:
             "antidote_commit_batch_size", "Effects per commit batch",
             buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384),
         )
+        # process-wide fabric/RPC resilience counters ride along in this
+        # node's exposition (shared objects — see NetMetrics)
+        net_metrics().attach(r)
 
     # -- staleness observer (every 10 s in the reference,
     #    /root/reference/src/antidote_stats_collector.erl:87-93); here it

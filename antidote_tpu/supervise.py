@@ -43,6 +43,55 @@ class Child:
         self.restarts: List[float] = []  # monotonic restart times
 
 
+class ThreadLoop:
+    """A supervisable repeating-call thread (the worker-process shape
+    OTP's gen_server loop gives every subsystem for free).
+
+    ``fn`` is called repeatedly with ``interval_s`` sleeps between
+    calls; an exception logs, marks the loop crashed, and ENDS the
+    thread — the supervisor's ``alive`` probe then sees a dead child
+    and restarts it through the factory, which is the whole point:
+    threads must die loudly, not limp silently.
+
+        sup.add("interdc-pump",
+                start=lambda: ThreadLoop(fabric.pump, name="pump").start(),
+                alive=ThreadLoop.is_alive, stop=ThreadLoop.stop)
+    """
+
+    def __init__(self, fn: Callable[[], Any], interval_s: float = 0.01,
+                 name: str = "loop"):
+        self.fn = fn
+        self.interval_s = interval_s
+        self.name = name
+        self.crashed: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+
+    def start(self) -> "ThreadLoop":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.fn()
+            except Exception as e:
+                # die loudly: the supervisor restarts a fresh loop
+                self.crashed = e
+                log.exception("%s: loop crashed", self.name)
+                return
+            self._stop.wait(self.interval_s)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+
 class Supervisor:
     """one_for_one over service objects (antidote_sup parity: restart
     intensity ``max_restarts`` within ``window_s``, default 5-in-10s)."""

@@ -22,7 +22,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax: takes effect even after import (pre-backend-init)
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (no such option): the XLA_FLAGS set above did the job,
+    # provided no backend initialized before this conftest ran
+    pass
 
 from antidote_tpu.config import enable_compilation_cache  # noqa: E402
 

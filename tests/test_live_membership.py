@@ -121,11 +121,11 @@ def test_live_join_under_load_then_leave(cfg):
 
 
 def test_join_recovers_from_crash_mid_move(cfg, tmp_path):
-    """A member crashing after exporting (but before the import lands)
-    recovers with the moved layout from its prepare log; the driver's
-    retained package completes the move."""
-    from antidote_tpu.store import handoff as _handoff
-
+    """Two-phase move crash safety: a crash after export (before the
+    import is confirmed) destroys NOTHING — the source still owns the
+    only durable copy (ownership flips only at relinquish), the volatile
+    mid-move mark clears on restart, and a driver re-run completes the
+    move with a fresh export."""
     dirs = [str(tmp_path / f"m{i}") for i in range(2)]
     ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=2,
                         log_dir=dirs[i]) for i in range(2)]
@@ -141,14 +141,16 @@ def test_join_recovers_from_crash_mid_move(cfg, tmp_path):
         _wire(ms)
         for m in ms:
             m.m_join_begin(2, list(joiner.address), 3)
-        # move ONE shard by hand, crashing before the import: the
-        # exporter has durably relinquished; the package completes later
-        moves = plan_moves({s: int(o) for s, o in
+        # move ONE shard by hand, crashing the exporter before the
+        # import lands: two-phase export copied WITHOUT dropping, so the
+        # crash destroys nothing
+        moves = plan_moves({s: int(o) for s, (o, _e) in
                             ms[0].m_shard_map().items()}, 3)
         shard, src, dst = moves[0]
         data = ms[src].m_export_shard(shard, dst)
-        assert shard not in ms[src].shards
-        # "crash" the exporter and recover it from its log dir
+        assert shard in ms[src].shards      # still the owner (phase 1)
+        assert shard in ms[src].moving      # but refusing new work
+        del data  # the driver "crashes"; its package dies with it
         ms[src].close()
         ms[src].node.store.log.close()
         ms[src]._prep_wal.close()
@@ -161,18 +163,19 @@ def test_join_recovers_from_crash_mid_move(cfg, tmp_path):
             if m is not rec:
                 m.connect(src, *rec.address)
         _wire(ms)
-        assert shard not in rec.shards  # the own-event replayed
-        assert rec.shard_map[shard] == dst
-        # driver completes the interrupted move + the rest of the plan
-        ms[dst].m_import_shard(data)
-        for shard2, src2, dst2 in moves[1:]:
+        # the recovered source still owns the shard (no durable own
+        # event until relinquish) and the volatile mid-move mark cleared
+        assert shard in rec.shards
+        assert shard not in rec.moving
+        assert rec.shard_map[shard] == src
+        # a driver re-run completes the whole plan with fresh exports
+        for shard2, src2, dst2 in moves:
             d2 = ms[src2].m_export_shard(shard2, dst2)
             ms[dst2].m_import_shard(d2)
+            ms[src2].m_relinquish_shard(shard2, dst2)
             for m in ms:
                 if m.member_id not in (src2, dst2):
                     m.m_set_owner(shard2, dst2, 3)
-        for m in ms:
-            m.m_set_owner(shard, dst, 3)
         vals, _ = ClusterNode(ms[1]).read_objects(
             [(k, "counter_pn", "b") for k in range(12)])
         assert vals == [k + 1 for k in range(12)]

@@ -108,6 +108,32 @@ def test_bcounter_transfer_over_socket_query_channel(dcs):
     assert vals[0] == 6
 
 
+def test_public_host_keeps_local_dialing_on_bind_address(cfg):
+    """--public-host with an external DNS/LB name must not break
+    in-process observe_dc/_rpc: local dialing uses the BIND address;
+    the public name appears only in exported descriptors."""
+    fabrics = [TcpFabric(public_host="lb.invalid") for _ in range(2)]
+    nodes = [AntidoteNode(cfg, dc_id=i) for i in range(2)]
+    reps = [DCReplica(n, f, f"dc{i}")
+            for i, (n, f) in enumerate(zip(nodes, fabrics))]
+    TcpFabric.interconnect(fabrics)
+    try:
+        # in-process subscribe + catch-up RPC dial 127.0.0.1, not the
+        # unresolvable advertised name
+        reps[1].observe_dc(reps[0])
+        nodes[0].update_objects([("k", "counter_pn", "b", ("increment", 2))])
+        pump_all(fabrics)
+        vals, _ = nodes[1].read_objects(
+            [("k", "counter_pn", "b")], clock=nodes[1].store.dc_max_vc())
+        assert vals == [2]
+        # the wire descriptor carries the public name for REMOTE DCs
+        assert reps[0].descriptor().address[0] == "lb.invalid"
+        assert fabrics[0].address_of(0)[0] == "127.0.0.1"
+    finally:
+        for f in fabrics:
+            f.close()
+
+
 def test_parallel_writes_from_all_dcs(dcs):
     fabrics, nodes, reps = dcs
     for i, n in enumerate(nodes):
