@@ -29,8 +29,11 @@ def fabric_id_of(dc_id: int, member_id: int) -> int:
 
 def cluster_query_router(members_by_dc: Dict[int, int], n_shards: int):
     """(origin_dc, shard) -> fabric id of the publisher owning that
-    chain — how a subscriber finds the right catch-up endpoint when the
-    origin DC is clustered."""
+    chain under the INITIAL modular layout — the FALLBACK a subscriber
+    uses before any ownership gossip arrives for a chain.  Once a
+    publisher's (owner, epoch) stamps have been seen, the learned
+    ``DCReplica.shard_route`` entry takes precedence, so live membership
+    moves at the origin re-route catch-up without a reconnect."""
 
     def route(origin: int, shard: int) -> int:
         n = members_by_dc.get(origin, 1)
@@ -68,11 +71,12 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
     ts will exceed the counter), else the shard's applied chain frontier
     (an outstanding prepared txn may already hold a smaller issued ts).
 
-    KNOWN LIMITATION (documented, not silent): combining LIVE membership
-    change with geo-replication leaves inter-DC catch-up routing on the
-    boot-time modular map (cluster_query_router) — remote DCs learn the
-    new publisher layout only on reconnect.  Single-DC clusters (no
-    remote subscribers) are unaffected."""
+    Geo-replication follows LIVE membership change: every egress message
+    carries this member's (owner, shard-epoch) stamp, so remote DCs
+    re-route catch-up to the newest owner without a reconnect
+    (DCReplica.shard_route), and the export/import/relinquish hooks move
+    a shard's replication chain state (egress opids + sent window +
+    ingress positions) together with its data."""
     from antidote_tpu.interdc.replica import DCReplica
 
     replica = DCReplica(
@@ -80,6 +84,13 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
         shards=_LiveShards(member),
         fabric_id=fabric_id_of(member.dc_id, member.member_id),
     )
+    replica.owner_info = lambda shard: (
+        member.member_id, int(member.shard_epoch.get(int(shard), 0)))
+    member.export_extras.append(replica.export_shard_state)
+    member.on_shard_import.append(
+        lambda shard, extras: replica.adopt_shard(shard, extras))
+    member.on_shard_relinquish.append(replica.release_shard)
+
     def safe_time(shard: int) -> int:
         if (shard not in member.shards
                 or member.prepared_on_shard(shard)

@@ -65,6 +65,8 @@ def main(argv=None) -> int:
     node = ClusterNode(member)
     server = ProtocolServer(node, port=0)
 
+    subscribed = set()
+
     def ctl_wire(peers, remotes, members_by_dc) -> bool:
         for mid, (h, p) in peers.items():
             mid = int(mid)
@@ -77,8 +79,12 @@ def main(argv=None) -> int:
         )
         for fid in remotes:
             fid = int(fid)
-            if fid != replica.fabric_id and (fid & 0xFFFF) != member.dc_id:
+            if (fid != replica.fabric_id and (fid & 0xFFFF) != member.dc_id
+                    and fid not in subscribed):
+                # incremental re-wires (a joiner appearing mid-life) must
+                # not stack duplicate subscription streams
                 fabric.subscribe(replica.fabric_id, fid, replica._on_message)
+                subscribed.add(fid)
         # background pump: deliver the inter-DC stream + flush
         # heartbeats.  Supervised (5-in-10s, like console serve): a
         # crashed drain loop restarts loudly instead of silently
@@ -94,6 +100,22 @@ def main(argv=None) -> int:
             start=lambda: ThreadLoop(
                 lambda: fabric.pump(timeout=0.2), interval_s=0.01,
                 name="interdc-pump").start(),
+            alive=lambda lp: lp.is_alive(),
+            stop=lambda lp: lp.stop(),
+        )
+        # stable-time gossip on a timer (the meta_data_sender role,
+        # /root/reference/src/meta_data_sender.erl:224-255 — its cadence
+        # is 1 s; ours is 100 ms so read snapshots lag peers less on
+        # small clusters): without it,
+        # the aggregated stable snapshot stalls after a live shard move
+        # — the relinquished source's rows zero out and only a FRESH
+        # peer-row pull covers the shard from its new owner, but plain
+        # (unpinned) reads never spin on the clock and so never pulled
+        sup.add(
+            "clock-gossip",
+            start=lambda: ThreadLoop(
+                member.refresh_peer_clocks, interval_s=0.1,
+                name="clock-gossip").start(),
             alive=lambda lp: lp.is_alive(),
             stop=lambda lp: lp.stop(),
         )
@@ -115,6 +137,31 @@ def main(argv=None) -> int:
                         lambda: {str(k): bool(v)
                                  for k, v in node.check_ready().items()})
     member.rpc.register("ctl_status", lambda: node.status(include_ready=True))
+
+    def ctl_repl_status():
+        """Geo-replication introspection: per-chain positions, learned
+        ownership routes, and the raw shard clock matrix — what an
+        operator (or a membership test) reads to see WHERE a stalled
+        chain is stuck."""
+        vc = member.node.store.applied_vc
+        # snapshot under the ingress-state lock: the pump thread inserts
+        # into these dicts under it, and even a bare dict() copy can
+        # raise on a concurrent resize
+        with member.node.txm.commit_lock:
+            last_seen = dict(replica.last_seen)
+            shard_route = dict(replica.shard_route)
+        return {
+            "owned": sorted(int(s) for s in member.shards),
+            "pub_opid": [int(x) for x in replica.pub_opid],
+            "last_seen": {f"{o}:{s}": int(v)
+                          for (o, s), v in last_seen.items()},
+            "shard_route": {f"{o}:{s}": [int(mm), int(e)]
+                            for (o, s), (mm, e) in shard_route.items()},
+            "applied_vc": [[int(x) for x in row] for row in vc],
+            "stable_vc": [int(x) for x in member.stable_vc()],
+        }
+
+    member.rpc.register("ctl_repl_status", ctl_repl_status)
 
     print(json.dumps({
         "rpc": list(member.address),

@@ -178,20 +178,32 @@ class ClusterNode:
             return vals, t.snapshot_vc
         return self._read(objects, txn)
 
+    #: how long coordinators ride out one shard's move window before
+    #: giving up.  TIME-based, not attempt-based: an import at the
+    #: destination can sit in cold XLA compiles for many seconds, and a
+    #: fixed retry count silently shrinks with RPC latency (riak_core's
+    #: vnode handoff imposes the same wait; its commands park in the
+    #: vnode proxy until the fold finishes)
+    MOVE_WAIT_S = 30.0
+
     def _read(self, objects, txn: ClusterTxn) -> list:
         # a live shard move lands between routing and the owner call as a
         # retryable not_owner/busy reply; the map refresh + retry rides
         # out the one-shard move window (the only blocking riak_core
         # handoff also imposes)
-        for _ in range(200):
+        deadline = time.monotonic() + self.MOVE_WAIT_S
+        while True:
             try:
                 return self._read_routed(objects, txn)
             except RuntimeError as e:
                 if "not_owner" not in str(e) and "busy" not in str(e):
                     raise
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "shard ownership unstable: read retries "
+                        f"exhausted after {self.MOVE_WAIT_S}s") from e
                 self._refresh_shard_map()
                 time.sleep(0.02)
-        raise RuntimeError("shard ownership unstable: read retries exhausted")
 
     def _read_routed(self, objects, txn: ClusterTxn) -> list:
         assert txn.active
@@ -339,7 +351,8 @@ class ClusterNode:
                 # the txn's own pending effects for the key overlaid
                 # (observed-remove must see same-txn adds); incremental
                 # shipping with a full-resend fallback on overlay-resync
-                full, moves = False, 0
+                full = False
+                move_deadline = time.monotonic() + self.MOVE_WAIT_S
                 while True:
                     owner = self._owner_of(key, bucket)
                     overlay = self._overlay_payload(txn, key, bucket,
@@ -362,11 +375,10 @@ class ClusterNode:
                             full = True
                             continue
                         if ("not_owner" in str(e) or "busy" in str(e)) \
-                                and moves < 200:
+                                and time.monotonic() < move_deadline:
                             # live shard move in flight: refresh + retry
                             # (the new owner has no overlay prefix —
                             # resend in full)
-                            moves += 1
                             full = True
                             self._refresh_shard_map()
                             time.sleep(0.02)
@@ -410,7 +422,8 @@ class ClusterNode:
         snap_own = int(txn.snapshot_vc[self.dc_id])
         last_busy = None
         t_retry0 = time.monotonic()
-        for moves in range(200):
+        move_deadline = t_retry0 + self.MOVE_WAIT_S
+        while True:
             by_owner: Dict[Optional[int], list] = {}
             shards = set()
             for eff in txn.writeset:
@@ -439,6 +452,12 @@ class ClusterNode:
                     # live shard move in flight: re-route and re-prepare
                     # (the aborts released any locks already taken)
                     last_busy = e
+                    if time.monotonic() > move_deadline:
+                        raise RuntimeError(
+                            "shard ownership unstable: prepare retries "
+                            f"exhausted after "
+                            f"{time.monotonic() - t_retry0:.2f}s "
+                            f"(last: {last_busy})") from last_busy
                     self._refresh_shard_map()
                     time.sleep(0.02)
                     continue
@@ -454,11 +473,6 @@ class ClusterNode:
             except Exception:
                 self._abort_prepared(txn.txid, prepared)
                 raise
-        else:
-            raise RuntimeError(
-                "shard ownership unstable: prepare retries exhausted "
-                f"after {time.monotonic() - t_retry0:.2f}s "
-                f"(last: {last_busy})") from last_busy
         # one DC-wide timestamp + per-shard chains from the sequencer
         # (ledgered under the txid so takeover can find this txn)
         ts, prev = self._seq(sorted(shards), txn.txid)

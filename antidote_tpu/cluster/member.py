@@ -69,7 +69,18 @@ log = logging.getLogger(__name__)
 
 
 def owned_shards(cfg: AntidoteConfig, member_id: int, n_members: int):
+    """The INITIAL (boot-time) modular layout.  Ownership afterwards is
+    governed solely by the explicit shard map + live join/leave moves."""
     return [s for s in range(cfg.n_shards) if s % n_members == member_id]
+
+
+def _count_shard_move(role: str) -> None:
+    try:
+        from antidote_tpu.obs.metrics import net_metrics
+
+        net_metrics().shard_moves.inc(role=role)
+    except Exception:  # metrics must never break a move
+        pass
 
 
 #: bound on remembered txn outcomes / ledger entries (GC floor)
@@ -188,17 +199,22 @@ class ClusterMember:
                 "through the live join/leave protocol so every member's "
                 "shard map stays consistent")
         #: shard -> owning member id — the explicit ownership map (the
-        #: riak_core ring analogue).  Starts modular; live join/leave
-        #: updates it in lock-step with the data moves, and stale
-        #: coordinators converge through not_owner retry.
+        #: riak_core ring analogue) and the SINGLE routing truth: starts
+        #: modular, then live join/leave updates it in lock-step with
+        #: the data moves (durable own events), and stale coordinators
+        #: converge through not_owner retry.  ``n_members`` is the
+        #: member-id-space BOUND (max assigned id + 1), not a live
+        #: count — a mid-id live leave opens a gap that nothing modular
+        #: routes across.
         #
-        #: A live-joining member (explicit EMPTY shard set) boots with
-        #: the CURRENT layout — modular over the pre-join count — not
-        #: the future one: epoch-guarded refreshes never downgrade a
-        #: map entry, so a speculative future-layout guess would leave
-        #: the joiner routing to not-yet-owners for the whole join.
-        #: live_join enforces contiguous ids with the joiner last, so
-        #: the pre-join count is n_members - 1.
+        #: A live-joining member (explicit EMPTY shard set) boots with a
+        #: GUESS of the current layout — modular over the pre-join
+        #: count — not the future one: epoch-guarded refreshes never
+        #: downgrade a map entry, so a speculative future-layout guess
+        #: would leave the joiner routing to not-yet-owners for the
+        #: whole join.  The live_join driver then seeds the REAL map
+        #: (m_seed_map), which matters once earlier joins/leaves have
+        #: reshaped it away from modular.
         layout_n = n_members
         if shards is not None and not self.shards and n_members > 1:
             layout_n = n_members - 1
@@ -246,8 +262,24 @@ class ClusterMember:
         self.chain_wait: Dict[int, Dict[int, tuple]] = {
             s: {} for s in self.shards
         }
+        #: member ids that live-LEFT this cluster (durable): a departed
+        #: id must never be handed out again — its log dir and the
+        #: (owner, epoch) routes remote DCs learned for its fabric id
+        #: would alias the new member.  Wiring alone cannot distinguish
+        #: an interrupted-join re-run from a reuse; this set can.
+        self.departed: set = set()
         #: commit listeners (inter-DC egress seam): (effects, vc, origin)
         self.on_commit: List = []
+        #: live-move seams for the inter-DC plane (attach_interdc):
+        #: export_extras(shard) dicts merge into the handoff package's
+        #: "x" namespace; on_shard_import(shard, extras) installs them at
+        #: the destination; on_shard_relinquish(shard) clears the
+        #: source's egress/ingress chain state.  All three run under the
+        #: cross-plane commit lock, so they are serialized against the
+        #: remote-ingress drain.
+        self.export_extras: List = []
+        self.on_shard_import: List = []
+        self.on_shard_relinquish: List = []
         #: txid -> (vc_wire, prev_wire) of applied commits (takeover polls)
         self.committed_txns: "OrderedDict[int, tuple]" = OrderedDict()
         #: txids barred from committing pending a takeover decision
@@ -304,11 +336,31 @@ class ClusterMember:
                      "m_ready", "m_seq_counter", "m_txn_status",
                      "m_block_txn", "m_forget_txn", "m_resolve_chain",
                      "m_txn_sequenced", "m_resolve_stale_txn",
-                     "m_process_transfer", "m_shard_map", "m_join_begin",
-                     "m_export_shard", "m_import_shard",
+                     "m_process_transfer", "m_shard_map", "m_membership",
+                     "m_join_begin",
+                     "m_seed_map", "m_export_shard", "m_import_shard",
                      "m_relinquish_shard", "m_cancel_export", "m_set_owner",
                      "m_forget_member"):
             self.rpc.register(name, getattr(self, name))
+
+    @property
+    def _xlock(self):
+        """Cross-plane writer lock (the node's reentrant commit lock).
+
+        ``KVStore.apply_effects`` is a read-modify-reassign of the
+        device tables, so the store tolerates exactly ONE concurrent
+        writer.  For a clustered member there are two writer planes: own
+        commits (RPC server threads, ``m_commit``/``m_forget_txn``) and
+        remote inter-DC ingress (the fabric pump's gate drain, which
+        already serializes under ``node.txm.commit_lock`` — the r5
+        advisor high).  Every member path that mutates or snapshots
+        store state takes THIS lock first, then ``self._lock`` — the
+        one consistent order (nothing acquires the commit lock while
+        holding the member lock), so a pump drain can never interleave
+        with a member-side apply and silently drop a batch.  Shard
+        export/import/relinquish take it too: a package must not be
+        built (or installed) while remote effects are landing."""
+        return self.node.txm.commit_lock
 
     def coordinator(self):
         """This member's own transaction coordinator (any member may
@@ -345,6 +397,25 @@ class ClusterMember:
             if os.path.exists(tmp):
                 os.remove(tmp)
             w = ShardWAL(tmp, sync_on_commit=False)
+            # MEMBERSHIP STATE FIRST: compaction rewrites the log from
+            # live state, and without these records a post-move member
+            # would recover with the modular GUESS of its recover-time
+            # count — silently claiming shards it gave away.  One
+            # boot_layout (actual owned set + id-space bound), the full
+            # current map with epochs, and the departed-id set.
+            w.append({"ev": "boot_layout", "txid": 0,
+                      "n": int(self.n_members),
+                      "member": int(self.member_id),
+                      "shards": sorted(int(s) for s in self.shards)})
+            for s in range(self.cfg.n_shards):
+                w.append({"ev": "own", "txid": 0, "shard": int(s),
+                          "owner": int(self.shard_map.get(s, 0)),
+                          "epoch": int(self.shard_epoch.get(s, 0))})
+            w.append({"ev": "members", "txid": 0,
+                      "n": int(self.n_members)})
+            for mid in sorted(self.departed):
+                w.append({"ev": "departed", "txid": 0,
+                          "member": int(mid)})
             if self.seq is not None:
                 for ts, (txid, shards, prev, _) in self.seq.issued.items():
                     w.append({"ev": "seq", "ts": int(ts), "txid": int(txid),
@@ -449,7 +520,11 @@ class ClusterMember:
                     self.applied_ts.pop(s, None)
                     self.chain_wait.pop(s, None)
             elif ev == "members":
-                self.n_members = int(rec["n"])
+                # monotone on replay too: pre-fix logs may hold a
+                # shrunken value from an old leave driver
+                self.n_members = max(self.n_members, int(rec["n"]))
+            elif ev == "departed":
+                self.departed.add(int(rec["member"]))
         self._trim_ledgers()
         return pending
 
@@ -881,15 +956,57 @@ class ClusterMember:
         return {int(s): [int(m), int(self.shard_epoch.get(int(s), 0))]
                 for s, m in self.shard_map.items()}
 
-    def m_join_begin(self, new_id: int, new_addr, n_members_new: int) -> bool:
-        """Learn a joining member: wire its RPC, grow the member count.
-        Ownership is untouched — shards move one by one afterwards."""
+    def m_membership(self) -> dict:
+        """Membership introspection for drivers: the id-space bound
+        (monotone), the live member ids this member knows (self + wired
+        peers), and the DURABLE departed-id set — the authoritative
+        never-reuse list (a wired peer entry cannot distinguish an
+        interrupted-join re-run from a reused id; this set can)."""
         with self._lock:
-            self.n_members = int(n_members_new)
+            return {"n_members": int(self.n_members),
+                    "members": sorted({self.member_id, *self.peers}),
+                    "departed": sorted(int(m) for m in self.departed)}
+
+    def m_join_begin(self, new_id: int, new_addr, n_members_new: int) -> bool:
+        """Learn a joining member: wire its RPC, grow the id-space bound
+        (``n_members`` is a BOUND on assigned member ids, not a live
+        count — mid-id leaves open gaps).  Ownership is untouched —
+        shards move one by one afterwards."""
+        with self._lock:
+            self.n_members = max(self.n_members, int(n_members_new))
             if new_id != self.member_id and new_id not in self.peers:
                 self.connect(int(new_id), new_addr[0], int(new_addr[1]))
             self._prep_append({"ev": "members", "txid": 0,
-                               "n": int(n_members_new)})
+                               "n": int(self.n_members)})
+        return True
+
+    def m_seed_map(self, entries, n_members: Optional[int] = None) -> bool:
+        """Adopt an authoritative ownership-map snapshot ``{shard:
+        [owner, epoch]}`` (live-join driver seeding).  A joiner boots
+        with a modular GUESS of the current layout; if earlier
+        joins/leaves reshaped the map, same-epoch entries of that guess
+        would survive epoch-guarded refreshes forever — so the driver
+        seeds the real map, adopting entries at or above the local epoch
+        for shards not owned here (equal-epoch entries from a live
+        member are at least as correct as any guess; genuinely moved
+        shards always carry a strictly higher epoch).  Adopted changes
+        are durable own events: a joiner crashing mid-join recovers the
+        seeded layout, not the guess."""
+        with self._lock:
+            if n_members is not None:
+                self.n_members = max(self.n_members, int(n_members))
+            for s, ent in entries.items():
+                s = int(s)
+                owner, epoch = int(ent[0]), int(ent[1])
+                if s in self.shards or epoch < self.shard_epoch.get(s, 0):
+                    continue
+                if (self.shard_map.get(s) == owner
+                        and self.shard_epoch.get(s, 0) == epoch):
+                    continue
+                self.shard_map[s] = owner
+                self.shard_epoch[s] = epoch
+                self._prep_append({"ev": "own", "txid": 0, "shard": s,
+                                   "owner": owner, "epoch": epoch})
         return True
 
     def m_set_owner(self, shard: int, owner: int,
@@ -903,7 +1020,11 @@ class ClusterMember:
         with self._lock:
             shard, owner = int(shard), int(owner)
             if n_members is not None:
-                self.n_members = int(n_members)
+                # monotone like m_forget_member: a leave driver computes
+                # its bound from the CURRENT rpcs map, which undercounts
+                # whenever a higher id departed earlier — taking the max
+                # keeps departed ids unreusable on every member
+                self.n_members = max(self.n_members, int(n_members))
             if epoch is not None and int(epoch) < self.shard_epoch.get(
                     shard, 0):
                 return True  # stale replay of an older move
@@ -938,7 +1059,7 @@ class ClusterMember:
         from antidote_tpu.store import handoff as _handoff
 
         shard, target = int(shard), int(target)
-        with self._lock:
+        with self._xlock, self._lock:
             if shard not in self.shards:
                 # NOT _check_owner: a shard mid-move is still owned here,
                 # and a driver retry may legitimately re-export it (the
@@ -962,6 +1083,11 @@ class ClusterMember:
             # adopt it, and the relinquish/broadcast carry it so stale
             # pre-move map entries can never clobber the new owner
             pkg["owner_epoch"] = int(self.shard_epoch.get(shard, 0)) + 1
+            # plane extras (inter-DC egress/ingress chain state): taken
+            # under both locks, so they are exactly consistent with the
+            # package — no commit or remote apply can land in between
+            for fn in self.export_extras:
+                pkg.setdefault("x", {}).update(fn(shard))
             data = _handoff.pack(pkg)
             self.moving.add(shard)
         return data
@@ -975,21 +1101,37 @@ class ClusterMember:
         from antidote_tpu.store import handoff as _handoff
 
         shard, target = int(shard), int(target)
-        with self._lock:
-            self.moving.discard(shard)
-            if shard not in self.shards:
-                # duplicate relinquish after a driver retry
-                return int(self.shard_epoch.get(shard, 0))
-            _handoff.drop_shard(self.node.store, shard)
-            # copy-on-write: lock-free readers iterate the old set
-            self.shards = self.shards - {shard}
-            self.shard_map[shard] = target
-            epoch = int(self.shard_epoch.get(shard, 0)) + 1
-            self.shard_epoch[shard] = epoch
-            self.applied_ts.pop(shard, None)
-            self.chain_wait.pop(shard, None)
-            self._prep_append({"ev": "own", "txid": 0, "shard": shard,
-                               "owner": target, "epoch": epoch})
+        with self._xlock:
+            with self._lock:
+                self.moving.discard(shard)
+                if shard not in self.shards:
+                    # duplicate relinquish after a driver retry — the
+                    # hooks below still re-run: the retry may exist
+                    # because a hook failed after the durable flip, and
+                    # release_shard is idempotent
+                    dup = True
+                    epoch = int(self.shard_epoch.get(shard, 0))
+                else:
+                    dup = False
+                    _handoff.drop_shard(self.node.store, shard)
+                    # copy-on-write: lock-free readers iterate the old set
+                    self.shards = self.shards - {shard}
+                    self.shard_map[shard] = target
+                    epoch = int(self.shard_epoch.get(shard, 0)) + 1
+                    self.shard_epoch[shard] = epoch
+                    self.applied_ts.pop(shard, None)
+                    self.chain_wait.pop(shard, None)
+                    self._prep_append({"ev": "own", "txid": 0,
+                                       "shard": shard, "owner": target,
+                                       "epoch": epoch})
+            # still under the cross-plane lock (serialized vs the ingress
+            # drain), out of the member lock: clear the inter-DC chain
+            # state — queued remote txns for a shard we no longer hold
+            # must never apply to the dropped slice
+            for fn in self.on_shard_relinquish:
+                fn(shard)
+            if not dup:
+                _count_shard_move("relinquish")
         return epoch
 
     def m_cancel_export(self, shard: int) -> bool:
@@ -1008,9 +1150,32 @@ class ClusterMember:
 
         pkg = _handoff.unpack(bytes(data))
         shard = int(pkg["shard"])
+        with self._xlock:
+            dup = False
+            with self._lock:
+                if shard in self.shards:
+                    # duplicate delivery after a driver retry: the data
+                    # is installed, but the plane hooks below must still
+                    # re-run — the retry may exist precisely BECAUSE a
+                    # hook failed mid-way on the first delivery, and
+                    # skipping them would strand the egress chain at its
+                    # partial state (adopt_shard is idempotent/monotone)
+                    dup = True
+            if not dup:
+                self._import_pkg_locked(shard, pkg)
+            extras = pkg.get("x", {})
+            for fn in self.on_shard_import:
+                fn(shard, extras)
+            if not dup:
+                _count_shard_move("import")
+        return True
+
+    def _import_pkg_locked(self, shard: int, pkg: dict) -> None:
+        """Install a handoff package's data + ownership (fresh import
+        leg of :meth:`m_import_shard`; caller holds the cross-plane
+        lock).  The inter-DC chain-state hooks run in the caller, for
+        duplicates too."""
         with self._lock:
-            if shard in self.shards:
-                return True  # duplicate delivery after a driver retry
             self.node.receive_handoff(pkg)
             self.shards = self.shards | {shard}
             self.shard_map[shard] = self.member_id
@@ -1031,7 +1196,6 @@ class ClusterMember:
             self._prep_append({"ev": "own", "txid": 0, "shard": shard,
                                "owner": self.member_id,
                                "epoch": int(self.shard_epoch[shard])})
-        return True
 
     def m_prepare(self, txid: int, effs_wire: list, snap_own: int) -> bool:
         """Certify + lock this txn's keys on my shards
@@ -1097,7 +1261,7 @@ class ClusterMember:
         # waiting on it) need not wait out the 0.2 s cache refresh
         if self.seq is None and ts > self._seq_cache:
             self._seq_cache = ts
-        with self._lock:
+        with self._xlock, self._lock:
             if txid in self.aborted_txns:
                 raise RuntimeError(
                     f"abort: txn {txid} was resolved-aborted by takeover")
@@ -1177,7 +1341,7 @@ class ClusterMember:
         """Apply a takeover ABORT decision: release the txn's staged
         write-set + locks and close its hole in my owned shards' ts
         chains (a no-op link, so successors drain)."""
-        with self._lock:
+        with self._xlock, self._lock:
             self.blocked_txns.discard(txid)
             if txid not in self.aborted_txns:
                 self.aborted_txns[txid] = True
@@ -1453,20 +1617,36 @@ class ClusterMember:
                 # keep its last gossiped rows; staleness is safe (mins
                 # only lag) and takeover/rewire handles the rest
                 continue
-            mat = self.peer_clocks.get(mid)
-            if mat is None:
-                mat = np.zeros((self.cfg.n_shards, self.cfg.max_dcs),
-                               np.int32)
-                self.peer_clocks[mid] = mat
+            with self._lock:
+                # insert under the member lock: clock_matrix iterates
+                # this dict on every snapshot, and a lock-free insert
+                # (first gossip from a joiner) racing that iteration
+                # raises "dictionary changed size during iteration".
+                # Re-check liveness: a leave's m_forget_member may have
+                # dropped this peer while our m_clocks call was in
+                # flight, and re-inserting would permanently resurrect
+                # the departed member's rows (undoing the cleanup)
+                if mid not in self.peers:
+                    continue
+                mat = self.peer_clocks.get(mid)
+                if mat is None:
+                    mat = np.zeros((self.cfg.n_shards, self.cfg.max_dcs),
+                                   np.int32)
+                    self.peer_clocks[mid] = mat
             for s, row in rows:
                 np.maximum(mat[s], np.asarray(row, np.int32), out=mat[s])
 
     def m_forget_member(self, member_id: int, n_members_new: int) -> bool:
         """Drop a departed member (live leave): close + remove its peer
-        client and gossip rows, shrink the member count."""
+        client and gossip rows.  The id-space bound is MONOTONE — the
+        driver passes it unchanged, so a departed id (highest or not)
+        is never handed out again: its durable log dir and the routes
+        remote DCs learned for it must never alias a new member."""
         with self._lock:
             member_id = int(member_id)
-            self.n_members = int(n_members_new)
+            # monotone: never shrink (a smaller value from an old driver
+            # would reopen a departed id for reuse)
+            self.n_members = max(self.n_members, int(n_members_new))
             cli = self.peers.pop(member_id, None)
             if cli is not None:
                 try:
@@ -1474,15 +1654,20 @@ class ClusterMember:
                 except Exception:
                     pass
             self.peer_clocks.pop(member_id, None)
+            self.departed.add(member_id)
             self._prep_append({"ev": "members", "txid": 0,
-                               "n": int(n_members_new)})
+                               "n": int(self.n_members)})
+            self._prep_append({"ev": "departed", "txid": 0,
+                               "member": member_id})
         return True
 
     def clock_matrix(self) -> np.ndarray:
         """The DC's full (shards x D) applied matrix: my owned rows live,
         peer rows from gossip."""
         mat = self.node.store.applied_vc.copy()
-        for mid, peer in self.peer_clocks.items():
+        # list(): the gossip loop inserts / m_forget_member pops rows
+        # concurrently; a stale snapshot of the dict is safe (mins lag)
+        for mid, peer in list(self.peer_clocks.items()):
             for s in range(self.cfg.n_shards):
                 if s not in self.shards:
                     np.maximum(mat[s], peer[s], out=mat[s])

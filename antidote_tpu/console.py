@@ -266,6 +266,46 @@ def cmd_cluster_sweep(args) -> int:
     return 0
 
 
+def _parse_member_rpcs(spec: str):
+    """``0=host:port,1=host:port,...`` -> {member_id: (host, port)}."""
+    out = {}
+    for part in spec.split(","):
+        mid, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        out[int(mid)] = (host, int(port))
+    return out
+
+
+def _move_progress(shard, src, dst, done, total):
+    log(f"[{done}/{total}] shard {shard}: member {src} -> member {dst}")
+
+
+def cmd_cluster_join(args) -> int:
+    """Live-join a booted-empty member into a serving DC (the staged
+    join + ownership handoff of antidote_console.erl:34-50), with
+    per-shard progress on stderr.  The joiner must already be running
+    (`cluster.boot --joining`) and wired (`ctl_wire`)."""
+    from antidote_tpu.cluster.join import live_join
+
+    rpcs = _parse_member_rpcs(args.rpcs)
+    moved = live_join(rpcs, new_id=args.joiner, progress=_move_progress)
+    print(json.dumps({"joined": args.joiner, "moved": moved}))
+    return 0
+
+
+def cmd_cluster_leave(args) -> int:
+    """Live-drain ANY member (except member 0, the sequencer) out of a
+    serving DC: its shards stream to the least-loaded survivors, then
+    every survivor forgets it.  Shut the leaver down afterwards."""
+    from antidote_tpu.cluster.join import live_leave
+
+    rpcs = _parse_member_rpcs(args.rpcs)
+    moved = live_leave(rpcs, leaving_id=args.leaver,
+                       progress=_move_progress)
+    print(json.dumps({"left": args.leaver, "moved": moved}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="antidote_tpu.console")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -344,6 +384,29 @@ def main(argv=None) -> int:
         if name == "cluster-sweep":
             p.add_argument("--grace", type=float, default=30.0)
         p.set_defaults(fn=fn)
+
+    # live membership change (staged join/leave while the DC serves)
+    cj = sub.add_parser(
+        "cluster-join",
+        help="live-join a booted-empty member (shards stream over while "
+             "the cluster serves; per-shard progress on stderr)")
+    cj.add_argument("--rpcs", required=True,
+                    help="member control RPCs incl. the joiner, as "
+                         "id=host:port,id=host:port,...")
+    cj.add_argument("--joiner", type=int, required=True,
+                    help="joining member id (fresh, highest)")
+    cj.set_defaults(fn=cmd_cluster_join)
+
+    cl = sub.add_parser(
+        "cluster-leave",
+        help="live-drain any member but the sequencer (member 0) out of "
+             "a serving DC, then forget it everywhere")
+    cl.add_argument("--rpcs", required=True,
+                    help="member control RPCs incl. the leaver, as "
+                         "id=host:port,...")
+    cl.add_argument("--leaver", type=int, required=True,
+                    help="departing member id (any id except 0)")
+    cl.set_defaults(fn=cmd_cluster_leave)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -33,12 +33,24 @@ class TxnMessage:
     effects: List[Effect]
     #: heartbeat safe time: no future txn from origin will commit below this
     timestamp: int = 0
+    #: publisher-side shard ownership gossip (clustered origins): the
+    #: member id currently owning this shard's chain and its ownership
+    #: EPOCH (bumped per live move).  Subscribers re-route catch-up
+    #: queries to the newest-epoch owner — the inter_dc_sub re-wiring of
+    #: /root/reference/src/inter_dc_sub.erl:123-145, without a
+    #: reconnect.  None (absent on the wire) for single-member origins.
+    owner: Optional[int] = None
+    oepoch: Optional[int] = None
 
     @property
     def is_ping(self) -> bool:
         return not self.effects
 
     def to_bytes(self) -> bytes:
+        extra = {}
+        if self.owner is not None:
+            extra["ow"] = int(self.owner)
+            extra["oe"] = int(self.oepoch or 0)
         return msgpack.packb({
             "o": self.origin,
             "p": self.shard,
@@ -47,6 +59,7 @@ class TxnMessage:
             "cvc": [int(x) for x in np.asarray(self.commit_vc)],
             "svc": [int(x) for x in np.asarray(self.snapshot_vc)],
             "ts": self.timestamp,
+            **extra,
             "effs": [
                 {
                     "k": e.key, "t": e.type_name, "b": e.bucket,
@@ -67,6 +80,7 @@ class TxnMessage:
             commit_vc=np.asarray(m["cvc"], np.int32),
             snapshot_vc=np.asarray(m["svc"], np.int32),
             timestamp=m["ts"],
+            owner=m.get("ow"), oepoch=m.get("oe"),
             effects=[
                 Effect(
                     freeze_key(e["k"]), e["t"], e["b"],

@@ -68,8 +68,22 @@ class DCReplica:
         #: semantic origin in every message)
         self.fabric_id = self.dc_id if fabric_id is None else fabric_id
         #: (origin_dc, shard) -> fabric id serving that chain's catch-up
-        #: queries (identity for single-node DCs)
+        #: queries (identity for single-node DCs).  Only a FALLBACK for
+        #: chains whose ownership was never gossiped: learned
+        #: ``shard_route`` entries (below) take precedence.
         self.route_query = lambda origin, shard: origin
+        #: (origin_dc, shard) -> (owner member id, ownership epoch)
+        #: learned from publisher gossip (TxnMessage.owner/oepoch): the
+        #: live view of WHICH member of a clustered origin serves each
+        #: chain.  Strictly-newer epochs win, so a stale boot-time
+        #: router (or a replayed frame) can never point catch-up back at
+        #: a previous owner — membership change at the origin re-routes
+        #: here without any reconnect.
+        self.shard_route: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        #: shard -> (member id, ownership epoch) stamped into egress
+        #: messages; attach_interdc installs the member-backed form.
+        #: None = single-member origin, nothing to gossip.
+        self.owner_info = None
         p = node.cfg.n_shards
         #: egress opid chain per shard (my origin)
         self.pub_opid = np.zeros(p, np.int64)
@@ -147,16 +161,26 @@ class DCReplica:
                 out[at][2].append(effect_from_rec(rec))
         return out
 
+    def _owner_stamp(self, shard: int) -> tuple:
+        """(owner member id, ownership epoch) for egress gossip, or
+        (None, None) for single-member origins."""
+        if self.owner_info is None:
+            return (None, None)
+        ow, oe = self.owner_info(shard)
+        return (int(ow), int(oe))
+
     def _chain_message(self, shard: int, opid: int, vc: tuple,
                        effects: list) -> TxnMessage:
         """My-origin chain message #opid (1-based) for a shard."""
         cvc = np.asarray(vc, np.int32)
         svc = cvc.copy()
         svc[self.dc_id] = 0
+        ow, oe = self._owner_stamp(shard)
         return TxnMessage(
             origin=self.dc_id, shard=shard, prev_opid=opid - 1,
             last_opid=opid, commit_vc=cvc, snapshot_vc=svc,
             effects=effects, timestamp=int(cvc[self.dc_id]),
+            owner=ow, oepoch=oe,
         )
 
     def restore_from_log(self) -> None:
@@ -188,6 +212,107 @@ class DCReplica:
             for origin, n in counts.items():
                 if origin != self.dc_id:
                     self.last_seen[(origin, shard)] = n
+
+    # ------------------------------------------------------------------
+    # live shard moves (the ownership-handoff seam attach_interdc wires):
+    # a shard's replication chain state travels WITH the shard, so the
+    # new owner continues the (origin, shard) opid chain where the old
+    # one stopped — remote subscribers never see a chain restart.  Both
+    # run under the member's cross-plane lock, excluded vs the drain.
+    # ------------------------------------------------------------------
+    def adopt_shard(self, shard: int, extras=None) -> None:
+        """Install a moved-in shard's chain state from the handoff
+        package extras: egress opid + the recent sent window (catch-up
+        keeps serving through the move) and the remote-chain ingress
+        positions (gap detection resumes where the old owner stood).
+
+        Without extras (pre-extras package from a rolling upgrade, or
+        no inter-DC plane at the source) the EGRESS opid is recomputed
+        from the imported WAL the way :meth:`restore_from_log` does —
+        resuming at 0 would make every remote subscriber drop the new
+        owner's first N commits as chain duplicates (prev < their
+        last_seen), a silent permanent loss.  Ingress positions restart
+        at 0 in that case, which only costs a catch-up replay (the
+        chain-clock duplicate suppression makes it idempotent)."""
+        shard = int(shard)
+        extras = (extras or {}).get("interdc", {})
+        if "pub_opid" in extras:
+            opid = int(extras["pub_opid"])
+        elif self.node.store.log is not None:
+            # count my own-origin txn groups in the (just-imported) WAL
+            # chain; a huge my_effects_after skips effect materialization
+            opid = sum(1 for origin, _vc, _effs in self._wal_txn_groups(
+                shard, my_effects_after=1 << 62) if origin == self.dc_id)
+        else:
+            opid = 0  # WAL-less + extras-less: test-only configuration
+        # MONOTONE: adopt_shard re-runs on duplicate import deliveries
+        # (a driver retry after a mid-hook failure).  If commits already
+        # landed here since the first delivery, the chain advanced past
+        # the package's opid — rewinding it (or reinstalling the old
+        # window over newer messages) would corrupt the chain; anything
+        # a partial first run left out of the window is served from the
+        # WAL instead.
+        if opid > int(self.pub_opid[shard]):
+            self.pub_opid[shard] = opid
+            with self._sent_lock:
+                self.sent[shard].clear()
+                for data in extras.get("sent", ()):
+                    self.sent[shard].append(
+                        TxnMessage.from_bytes(bytes(data)))
+        for o, v in extras.get("last_seen", ()):
+            key = (int(o), shard)
+            if int(v) > self.last_seen.get(key, 0):
+                self.last_seen[key] = int(v)
+        self._published_safe.pop(shard, None)
+
+    def export_shard_state(self, shard: int) -> dict:
+        """The extras counterpart of :meth:`adopt_shard` — captured by
+        the member under both locks, so it is exactly consistent with
+        the handoff package (no commit or remote apply in between).
+
+        The exported ingress position is the APPLIED-safe one, not the
+        delivered one: ``last_seen`` advances at delivery, but a
+        dep-blocked txn can sit in the causal gate (and ``pending``)
+        without its effects being in the table slice the package
+        carries.  Exporting the delivered position would make the new
+        owner skip straight past those txns (no gap ⇒ no catch-up) —
+        a permanently lost effect.  So the position is clamped below
+        the earliest still-queued txn on each chain; the new owner's
+        catch-up refetches the suffix and re-gates it."""
+        shard = int(shard)
+        with self._sent_lock:
+            sent = [m.to_bytes() for m in self.sent[shard]]
+        last_seen = []
+        for (o, s), v in self.last_seen.items():
+            if s != shard:
+                continue
+            safe = int(v)
+            for m in self.gate.get((o, s), ()):
+                if not m.is_ping:
+                    safe = min(safe, int(m.prev_opid))
+                    break  # gate is FIFO in chain order
+            # (pending entries sit ABOVE a gap, i.e. past last_seen —
+            # dropping them is safe, catch-up refetches from last_seen)
+            last_seen.append([int(o), safe])
+        return {"interdc": {"pub_opid": int(self.pub_opid[shard]),
+                            "sent": sent, "last_seen": last_seen}}
+
+    def release_shard(self, shard: int) -> None:
+        """Clear a relinquished shard's chain state at the OLD owner:
+        its egress chain now lives at the importer, and any queued
+        remote txns must never apply to the dropped table slice (the
+        new owner replays them through catch-up instead)."""
+        shard = int(shard)
+        self.pub_opid[shard] = 0
+        with self._sent_lock:
+            self.sent[shard].clear()
+        self._published_safe.pop(shard, None)
+        for key in [k for k in self.last_seen if k[1] == shard]:
+            del self.last_seen[key]
+        for key in [k for k in self.pending if k[1] == shard]:
+            del self.pending[key]
+        for key in [k for k in self.gate if k[1] == shard]:
+            del self.gate[key]
 
     # ------------------------------------------------------------------
     def ingress_barrier(self):
@@ -266,12 +391,14 @@ class DCReplica:
         for shard, effs in by_shard.items():
             prev = int(self.pub_opid[shard])
             self.pub_opid[shard] += 1
+            ow, oe = self._owner_stamp(shard)
             msg = TxnMessage(
                 origin=origin, shard=shard, prev_opid=prev,
                 last_opid=prev + 1,
                 commit_vc=np.asarray(commit_vc, np.int32),
                 snapshot_vc=snapshot_vc, effects=effs,
                 timestamp=int(commit_vc[origin]),
+                owner=ow, oepoch=oe,
             )
             with self._sent_lock:
                 self.sent[shard].append(msg)
@@ -338,19 +465,31 @@ class DCReplica:
         self._commits_since_hb = 0
         self._last_hb = time.monotonic()
         vc = self.node.store.applied_vc
+        lock = self.node.txm.commit_lock
         for shard in sorted(self.shards):
-            safe = int(self.safe_time(shard))
-            vc[shard, self.dc_id] = max(vc[shard, self.dc_id], safe)
-            self._published_safe[shard] = safe
+            # stamp under the cross-plane lock and RE-CHECK membership:
+            # the tick-path heartbeat races a live relinquish, and a
+            # stale iteration could otherwise publish a ping stamped
+            # (old owner, already-bumped epoch) — subscribers would
+            # adopt it and then reject the REAL new owner's equal-epoch
+            # stamps forever, permanently mis-routing catch-up
+            with lock:
+                if shard not in self.shards:
+                    continue
+                safe = int(self.safe_time(shard))
+                vc[shard, self.dc_id] = max(vc[shard, self.dc_id], safe)
+                self._published_safe[shard] = safe
+                prev = int(self.pub_opid[shard])
+                ow, oe = self._owner_stamp(shard)
             if shard in exclude:
                 continue
-            prev = int(self.pub_opid[shard])
             msg = TxnMessage(
                 origin=self.dc_id, shard=shard, prev_opid=prev,
                 last_opid=prev,  # pings do not advance the chain
                 commit_vc=np.zeros(self.node.cfg.max_dcs, np.int32),
                 snapshot_vc=np.zeros(self.node.cfg.max_dcs, np.int32),
                 effects=[], timestamp=safe,
+                owner=ow, oepoch=oe,
             )
             self.hub.publish(self.fabric_id, msg.to_bytes())
 
@@ -441,47 +580,120 @@ class DCReplica:
             log.warning("discarding undecodable inter-DC frame (%d bytes)",
                         len(data))
             return
-        if msg.origin == self.dc_id or msg.shard not in self.shards:
+        if msg.origin == self.dc_id:
             return
+        # INGRESS STATE DISCIPLINE: last_seen/pending/gate mutate only
+        # under the node's commit lock — the same lock the gate drain
+        # and (via the member's cross-plane lock) live shard
+        # export/import/relinquish hold.  Without it, a relinquish can
+        # clear a shard's chain state while this handler is mid-flight
+        # and the resurrected entries would apply remote effects to the
+        # dropped slice.  The catch-up NETWORK call stays outside the
+        # lock (a dead endpoint's 30 s timeout must not freeze local
+        # commits); ownership is re-checked after it returns.
+        lock = self.node.txm.commit_lock
         key = (msg.origin, msg.shard)
-        last = self.last_seen.get(key, 0)
-        if msg.is_ping:
-            if msg.last_opid > last:
+        catchup_from = None
+        with lock:
+            self._learn_route(msg)
+            if msg.shard not in self.shards:
+                return
+            last = self.last_seen.get(key, 0)
+            if msg.is_ping:
+                if msg.last_opid <= last:
+                    self._queue(msg)
+                    self._drain_gates()
+                    return
                 # the ping reveals lost txns: catch up before trusting it
-                self._catch_up(key, last)
-            self._queue(msg)
+                catchup_from = last
+            elif msg.prev_opid == last:
+                self._accept(key, msg)
+                self._drain_gates()
+                return
+            elif msg.prev_opid > last:
+                # gap: buffer and query the origin's log reader (the
+                # catch-up's pending flush integrates this message)
+                self.pending[key].append(msg)
+                catchup_from = last
+            else:
+                return  # duplicate — drop
+        self._catch_up(key, catchup_from)
+        with lock:
+            if msg.shard not in self.shards:
+                return  # relinquished while we were catching up
+            if msg.is_ping:
+                if msg.last_opid > self.last_seen.get(key, 0):
+                    # the catch-up could NOT close the gap (severed query
+                    # channel, stale route to a dead old owner): trusting
+                    # the ping would advance the chain clock past the
+                    # undelivered txns, and the duplicate suppression
+                    # would then drop their eventual replay forever — a
+                    # permanently lost effect.  Drop the PING instead;
+                    # the publisher re-sends on its 1 s cadence and the
+                    # next one retries the catch-up.
+                    return
+                self._queue(msg)
             self._drain_gates()
+
+    def _learn_route(self, msg: TxnMessage) -> None:
+        """Adopt a publisher's shard-ownership gossip: strictly newer
+        epochs re-point this chain's catch-up route at the new owner
+        (replayed/stale frames can never resurrect a previous one)."""
+        if msg.owner is None:
             return
-        if msg.prev_opid == self.last_seen.get(key, 0):
-            self._accept(key, msg)
-        elif msg.prev_opid > self.last_seen.get(key, 0):
-            # gap: buffer and query the origin's log reader
-            self.pending[key].append(msg)
-            self._catch_up(key, self.last_seen.get(key, 0))
-        # else: duplicate — drop
-        self._drain_gates()
+        rk = (msg.origin, msg.shard)
+        oe = int(msg.oepoch or 0)
+        cur = self.shard_route.get(rk)
+        if cur is not None and oe <= cur[1]:
+            return
+        self.shard_route[rk] = (int(msg.owner), oe)
+        if cur is not None and cur[0] != int(msg.owner):
+            from antidote_tpu.obs.metrics import net_metrics
+
+            net_metrics().route_updates.inc()
+            log.info("chain %s: catch-up re-routed to member %d "
+                     "(epoch %d, was member %d)", rk, msg.owner, oe, cur[0])
+
+    def _route(self, origin: int, shard: int) -> int:
+        """Fabric id serving a chain's catch-up: the newest gossiped
+        owner when one is known, else the configured fallback router."""
+        ent = self.shard_route.get((origin, shard))
+        if ent is not None:
+            from antidote_tpu.cluster import fabric_id_of
+
+            return fabric_id_of(origin, ent[0])
+        return self.route_query(origin, shard)
 
     def _catch_up(self, key, from_opid) -> None:
         origin, shard = key
-        target = self.route_query(origin, shard)
+        target = self._route(origin, shard)
         try:
             msgs = self.hub.query_log(target, shard, origin, from_opid)
-        except (ConnectionError, OSError) as e:
-            # the query channel is down (partition, endpoint restart):
-            # keep the out-of-order buffer and return — every later ping
-            # on this chain re-reveals the gap and retries the catch-up,
-            # so healing the link heals the chain with no operator action
+        except (ConnectionError, OSError, KeyError) as e:
+            # the query channel is down (partition, endpoint restart) or
+            # the routed endpoint's address is not yet known (KeyError —
+            # gossip can outrun the operator's descriptor wiring for a
+            # just-joined member): keep the out-of-order buffer and
+            # return — every later ping on this chain re-reveals the gap
+            # and retries the catch-up, so healing the link (or wiring
+            # the endpoint) heals the chain with no operator action
             from antidote_tpu.obs.metrics import net_metrics
 
             net_metrics().catchup_failures.inc()
             log.warning("catch-up query to dc%s for chain %s failed (%r); "
                         "will retry on the next chain message", target, key, e)
             return
-        for data in msgs:
-            m = TxnMessage.from_bytes(data)
-            if not m.is_ping and m.prev_opid == self.last_seen.get(key, 0):
-                self._accept(key, m)
-        self._flush_pending(key)
+        # the replayed suffix lands under the commit lock (same ingress
+        # discipline as _on_message), with ownership re-checked: the
+        # shard may have been relinquished while the query was in flight
+        with self.node.txm.commit_lock:
+            if shard not in self.shards:
+                return
+            for data in msgs:
+                m = TxnMessage.from_bytes(data)
+                if not m.is_ping and m.prev_opid == self.last_seen.get(key, 0):
+                    self._accept(key, m)
+            self._flush_pending(key)
 
     def _accept(self, key, msg: TxnMessage) -> None:
         self.last_seen[key] = msg.last_opid

@@ -218,12 +218,23 @@ class NetMetrics:
             "Times the native receive plane was unavailable and the "
             "Python reader fallback engaged"
         )
+        self.shard_moves = Counter(
+            "antidote_cluster_shard_moves_total",
+            "Live shard ownership moves (two-phase handoff legs)",
+            ("role",)  # import | relinquish
+        )
+        self.route_updates = Counter(
+            "antidote_interdc_reroutes_total",
+            "Inter-DC catch-up routes re-pointed at a new shard owner "
+            "via ownership-epoch gossip"
+        )
 
     def all_metrics(self):
         return (self.reconnects, self.reconnect_attempts,
                 self.corrupt_frames, self.catchup_failures,
                 self.rpc_retries, self.rpc_deadline_exceeded,
-                self.faults_injected, self.pump_fallback)
+                self.faults_injected, self.pump_fallback,
+                self.shard_moves, self.route_updates)
 
     def attach(self, registry: "MetricsRegistry") -> None:
         """Register the shared counter objects into a node registry so

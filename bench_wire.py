@@ -49,6 +49,35 @@ import numpy as np
 
 HOST, PORT = "127.0.0.1", 0
 
+# ---------------------------------------------------------------------------
+# FROZEN driver shape (r5 VERDICT weak #3/#8): every BENCH_WIRE_*.json
+# artifact records this block verbatim, so numbers from different rounds
+# are comparable by construction — a driver change is visible as a `rev`
+# bump in the artifact, not an silent apples-to-oranges drift.
+# ---------------------------------------------------------------------------
+DRIVER_REV = 1
+WARM_ROUNDS = 8          # untimed ramp rounds (2 in --smoke)
+WARM_ROUND_S = 3         # seconds per ramp round
+WARM_EXIT_P99_MS = 50.0  # ramp exits early once p99 falls below this
+MEASURE_S = 10           # timed window (3 in --smoke)
+
+
+def driver_config(smoke: bool, workers: int, n_procs: int,
+                  read_frac: float, n_keys: int) -> dict:
+    """The artifact-side record of how the numbers were produced."""
+    return {
+        "rev": DRIVER_REV,
+        "workers": workers,
+        "procs": n_procs,
+        "ramp": {"rounds": 2 if smoke else WARM_ROUNDS,
+                 "round_s": WARM_ROUND_S,
+                 "exit_p99_ms": WARM_EXIT_P99_MS},
+        "duration_s": 3 if smoke else MEASURE_S,
+        "read_fraction": read_frac,
+        "keys": n_keys,
+        "smoke": bool(smoke),
+    }
+
 
 def _percentiles(lat_ms):
     a = np.asarray(lat_ms)
@@ -283,24 +312,32 @@ def bench_config(cfg_id, smoke, workers=32, read_frac=0.9, spawn=None,
         # family on first contact, and each compile is a multi-second
         # outage on a small host — measurement starts at steady state
         # (DB ramp-up, not billed), capped so a pathological tail can't
-        # stall the driver
-        for _ in range(2 if smoke else 8):
+        # stall the driver.  Shape constants are FROZEN module-level
+        # (DRIVER_REV etc.) and recorded in the artifact.
+        drv = driver_config(smoke, workers, n_procs, read_frac, n_keys)
+        for _ in range(drv["ramp"]["rounds"]):
             _, wlat, _ = _run_workers_mp(cfg_id, n_keys, read_frac, workers,
-                                         3, n_procs)
-            if wlat and float(np.percentile(wlat, 99)) < 50.0:
+                                         drv["ramp"]["round_s"], n_procs)
+            if wlat and (float(np.percentile(wlat, 99))
+                         < drv["ramp"]["exit_p99_ms"]):
                 break
-        dur = 3 if smoke else 10
+        dur = drv["duration_s"]
         ops, lat, workers_actual = _run_workers_mp(
             cfg_id, n_keys, read_frac, workers, dur, n_procs
         )
+        drv["workers"] = workers_actual
+        # the `driver` block is the single source of truth; the top-level
+        # copies remain only for dashboard/artifact back-compat and are
+        # DERIVED from it, never set independently
         out = {
             "config": cfg["name"] + tag,
             "ops_per_s": round(ops / dur, 1),
             "n_ops": ops,
-            "workers": workers_actual,
-            "driver_procs": n_procs,
-            "duration_s": dur,
-            "read_fraction": read_frac,
+            "workers": drv["workers"],
+            "driver_procs": drv["procs"],
+            "duration_s": drv["duration_s"],
+            "read_fraction": drv["read_fraction"],
+            "driver": drv,
             **_percentiles(lat),
         }
         print(json.dumps(out), flush=True)
@@ -345,7 +382,8 @@ def main():
                                     spawn=spawn, tag=tag))
     if args.json:
         with open(args.json, "w") as f:
-            json.dump({"results": results}, f, indent=2)
+            json.dump({"driver_rev": DRIVER_REV, "results": results},
+                      f, indent=2)
     return 0
 
 

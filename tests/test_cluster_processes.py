@@ -313,11 +313,12 @@ def test_kill9_after_seq_wedge_aborted_by_survivor(tmp_path):
 def test_live_join_across_processes(tmp_path):
     """Live membership across REAL OS processes (r4 VERDICT item 5): a
     2-member DC serves protocol clients while a third `cluster.boot
-    --joining` process joins via cluster.join.live_join over the control
-    RPC; writes continue through the join and every acked op survives."""
+    --joining` process joins via the OPERATOR CONSOLE path (`console
+    cluster-join`, r5 item 4) over the control RPC; writes continue
+    through the join and every acked op survives."""
     import threading
 
-    from antidote_tpu.cluster.join import live_join
+    from antidote_tpu import console
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -391,9 +392,16 @@ def test_live_join_across_processes(tmp_path):
             ctl = RpcClient(*i["rpc"])
             assert ctl.call("ctl_wire", peers3, remotes, {0: 3})
             ctl.close()
-        rpcs = {m: tuple(infos[m]["rpc"]) for m in (0, 1, 2)}
-        moved = live_join(rpcs, new_id=2)
-        assert moved > 0
+        # the operator console drives the join (progress lines land on
+        # stderr; the JSON summary on stdout)
+        spec = ",".join(f"{m}={infos[m]['rpc'][0]}:{infos[m]['rpc'][1]}"
+                        for m in (0, 1, 2))
+        assert console.main(["cluster-join", "--rpcs", spec,
+                             "--joiner", "2"]) == 0
+        ctl2 = RpcClient(*infos[2]["rpc"])
+        assert ctl2.call("ctl_status")["owned_shards"], \
+            "console join moved nothing to the joiner"
+        ctl2.close()
 
         time.sleep(1.0)
         stop.set()

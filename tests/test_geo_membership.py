@@ -1,0 +1,195 @@
+"""Geo-replication across LIVE membership change, OS-process tier.
+
+The r5 VERDICT item 2 acceptance shape: a 2-DC mesh under cross-DC
+write load live-joins a member at DC0 (through the operator console),
+then live-leaves a MIDDLE member and kills its process — a publisher
+dies for good.  Remote catch-up must land on the NEW owners of the
+moved chains via ownership-epoch gossip (the boot-time modular router
+still points at the dead/old members), with no fabric reconnect and no
+lost or duplicated ops: both DCs converge to the exact acked totals.
+
+DC1 is deliberately NOT subscribed to the joiner's endpoint until after
+the moves, so the moved chains accumulate a guaranteed gap — the
+catch-up trigger — which only the epoch-learned route can heal (the old
+owners' windows were cleared at relinquish; one of them is SIGKILLed).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from antidote_tpu import console
+from antidote_tpu.cluster.rpc import RpcClient
+from antidote_tpu.proto.client import AntidoteClient
+
+N_KEYS = 16  # int key k -> shard k % 8
+
+
+def _boot(spawned, infos, env, tmp_path, dc, member, members,
+          joining=False, max_dcs=2, shards=8):
+    cmd = [sys.executable, "-m", "antidote_tpu.cluster.boot",
+           "--dc-id", str(dc), "--member", str(member),
+           "--members", str(members), "--shards", str(shards),
+           "--max-dcs", str(max_dcs),
+           "--log-dir", str(tmp_path / f"d{dc}m{member}")]
+    if joining:
+        cmd.append("--joining")
+    errlog = os.environ.get("GEO_TEST_STDERR_DIR")
+    stderr = (open(os.path.join(errlog, f"d{dc}m{member}.log"), "w")
+              if errlog else subprocess.DEVNULL)
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=stderr)
+    spawned.append(p)
+    line = p.stdout.readline().decode()
+    assert line, "boot process died before announcing"
+    info = json.loads(line)
+    infos.append(info)
+    return info
+
+
+def _wire(info, peers, remotes, members_by_dc):
+    ctl = RpcClient(*info["rpc"])
+    assert ctl.call("ctl_wire", peers, remotes, members_by_dc)
+    ctl.close()
+
+
+def _writer(port_info, seed, amount, acked, lock, stop, errs):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    c = AntidoteClient(*port_info["client"])
+    try:
+        while not stop.is_set():
+            k = int(rng.integers(N_KEYS))
+            try:
+                c.update_objects(
+                    [(k, "counter_pn", "b", ("increment", amount))])
+            except Exception as e:
+                msg = str(e).lower()
+                # cert conflicts AND move-window exhaustion are both
+                # client-retryable non-acks: the coordinator aborted
+                # every prepared leg before surfacing either
+                if "abort" in msg or "unstable" in msg:
+                    continue
+                errs.append(repr(e))
+                return
+            with lock:
+                acked[k] += amount
+    finally:
+        c.close()
+
+
+def test_join_leave_kill_publisher_catchup_reroutes(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    spawned, infos = [], []
+    try:
+        m0 = _boot(spawned, infos, env, tmp_path, 0, 0, 2)
+        m1 = _boot(spawned, infos, env, tmp_path, 0, 1, 2)
+        dc1 = _boot(spawned, infos, env, tmp_path, 1, 0, 1)
+        peers0 = {0: m0["rpc"], 1: m1["rpc"]}
+        remotes = {i["fabric_id"]: i["fabric"] for i in (m0, m1, dc1)}
+        for i in (m0, m1):
+            _wire(i, peers0, remotes, {0: 2, 1: 1})
+        _wire(dc1, {0: dc1["rpc"]}, remotes, {0: 2, 1: 1})
+
+        acked = [0] * N_KEYS
+        lock = threading.Lock()
+        stop = threading.Event()
+        errs = []
+        ts = [threading.Thread(target=_writer,
+                               args=(m0, 21, 1, acked, lock, stop, errs)),
+              threading.Thread(target=_writer,
+                               args=(dc1, 22, 2, acked, lock, stop, errs))]
+        for t in ts:
+            t.start()
+        time.sleep(0.8)  # cross-DC load flowing both ways
+
+        # ---- live-join member 2 at DC0, console-driven, under load.
+        # DC1 is NOT wired to the joiner yet: everything the joiner
+        # publishes on its moved chains is missed — the catch-up gap.
+        m2 = _boot(spawned, infos, env, tmp_path, 0, 2, 3, joining=True)
+        peers3 = {0: m0["rpc"], 1: m1["rpc"], 2: m2["rpc"]}
+        remotes3 = dict(remotes)
+        remotes3[m2["fabric_id"]] = m2["fabric"]
+        for i in (m0, m1, m2):
+            _wire(i, peers3, remotes3, {0: 3, 1: 1})
+        spec = ",".join(f"{m}={i['rpc'][0]}:{i['rpc'][1]}"
+                        for m, i in ((0, m0), (1, m1), (2, m2)))
+        assert console.main(["cluster-join", "--rpcs", spec,
+                             "--joiner", "2"]) == 0
+        time.sleep(0.8)  # commits land on the joiner's chains, unseen
+
+        # ---- live-leave member 1 (a MIDDLE id) under the same load,
+        # then SIGKILL its process: a publisher dies for good.  Its
+        # chains moved to the survivors with their egress state.
+        assert console.main(["cluster-leave", "--rpcs", spec,
+                             "--leaver", "1"]) == 0
+        spawned[1].kill()
+        assert spawned[1].wait(timeout=30) is not None
+
+        # ---- only NOW does DC1 learn the joiner's endpoint (new
+        # wiring, not a reconnect of any existing stream).  The stale
+        # modular router ({0: 3}) points moved chains at the dead m1 or
+        # the relinquished old owners — only the gossiped (owner, epoch)
+        # stamps can land catch-up on the real owners.
+        remotes_live = {i["fabric_id"]: i["fabric"] for i in (m0, m2, dc1)}
+        _wire(dc1, {0: dc1["rpc"]}, remotes_live, {0: 3, 1: 1})
+
+        time.sleep(0.8)  # load continues on the gapped cluster
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+
+        with lock:
+            want = list(acked)
+        objs = [(k, "counter_pn", "b") for k in range(N_KEYS)]
+
+        # both DCs converge to the exact acked totals: zero lost ops
+        # (catch-up healed the joiner-chain gap from the NEW owners),
+        # zero duplicates (chain-clock suppression across the moves)
+        deadline = time.monotonic() + 90.0
+        last = None
+        while True:
+            ok = True
+            for info in (dc1, m0, m2):
+                c = AntidoteClient(*info["client"])
+                try:
+                    vals, _ = c.read_objects(objs)
+                finally:
+                    c.close()
+                last = (info["rpc"], vals)
+                if vals != want:
+                    ok = False
+                    break
+            if ok:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"DCs failed to converge: {last} expected {want}")
+            time.sleep(0.25)
+
+        # the survivors cover every shard between them; the leaver's id
+        # stays a gap (no renumbering)
+        ctl = RpcClient(*m0["rpc"])
+        st0 = ctl.call("ctl_status")
+        ctl.close()
+        ctl = RpcClient(*m2["rpc"])
+        st2 = ctl.call("ctl_status")
+        ctl.close()
+        assert sorted(st0["owned_shards"] + st2["owned_shards"]) == \
+            list(range(8))
+    finally:
+        for p in spawned:
+            if p.poll() is None:
+                p.terminate()
+        for p in spawned:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

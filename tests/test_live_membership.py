@@ -1,10 +1,15 @@
-"""Live membership change under load (r4 VERDICT item 5).
+"""Live membership change under load (r4 VERDICT item 5; r5 items 3/4).
 
 The reference joins/leaves nodes while serving (riak_core staged
 join/leave + ownership handoff, antidote_dc_manager:create_dc /
 antidote_console); here shards stream between members one at a time
-while coordinators keep committing — the test drives continuous writes
-THROUGH the whole join and asserts zero lost/duplicated ops.
+while coordinators keep committing — the tests drive continuous writes
+THROUGH joins and leaves and assert zero lost/duplicated ops.
+
+Routing truth is the explicit shard→(owner, epoch) map: joins stream
+shards only TO the joiner (balanced, minimal moves), and ANY member id
+except the sequencer can live-leave — a mid-id departure leaves a gap
+in the id space that nothing routes modularly across.
 """
 
 import threading
@@ -14,8 +19,9 @@ import numpy as np
 import pytest
 
 from antidote_tpu.cluster.coordinator import ClusterNode
-from antidote_tpu.cluster.join import live_join, live_leave, plan_moves
-from antidote_tpu.cluster.member import ClusterMember, owned_shards
+from antidote_tpu.cluster.join import (live_join, live_leave,
+                                       plan_join_moves, plan_leave_moves)
+from antidote_tpu.cluster.member import ClusterMember
 from antidote_tpu.config import AntidoteConfig
 
 
@@ -29,12 +35,28 @@ def cfg():
 def _wire(members):
     for i, m in enumerate(members):
         for j, o in enumerate(members):
-            if i != j and j not in m.peers:
-                m.connect(j, *o.address)
+            if i != j and o.member_id not in m.peers:
+                m.connect(o.member_id, *o.address)
 
 
 def _rpcs(members):
     return {m.member_id: tuple(m.address) for m in members}
+
+
+def _assert_consistent_layout(members, n_shards):
+    """Every member agrees on one complete map; owned sets partition the
+    shard space and match the shared map."""
+    ref = members[0].shard_map
+    for m in members[1:]:
+        assert m.shard_map == ref, (m.member_id, m.shard_map, ref)
+    owned = {}
+    for m in members:
+        for s in m.shards:
+            assert s not in owned, f"shard {s} owned twice"
+            owned[s] = m.member_id
+    assert set(owned) == set(range(n_shards))
+    assert owned == ref
+    return ref
 
 
 def test_live_join_under_load_then_leave(cfg):
@@ -72,15 +94,17 @@ def test_live_join_under_load_then_leave(cfg):
             t.start()
         time.sleep(1.0)  # load running against the 2-member cluster
 
-        # ---- live join member 2, WHILE the writers run
+        # ---- live join member 2, WHILE the writers run: the balanced
+        # plan streams shards only TO the joiner (2 of 8 here), never
+        # reshuffling the survivors — minimal moves, not a modular remap
         joiner = ClusterMember(cfg, dc_id=0, member_id=2, n_members=3,
                                shards=[])
         live.append(joiner)
         _wire(live)
         moved = live_join(_rpcs(live), new_id=2)
-        assert moved == len(plan_moves(
-            {s: s % 2 for s in range(cfg.n_shards)}, 3))
-        assert joiner.shards == set(owned_shards(cfg, 2, 3))
+        assert moved == len(plan_join_moves(
+            {s: s % 2 for s in range(cfg.n_shards)}, 2)) == 2
+        assert joiner.shards == {0, 1}
 
         time.sleep(1.0)  # load continues on the 3-member cluster
         stop.set()
@@ -88,10 +112,10 @@ def test_live_join_under_load_then_leave(cfg):
             t.join(timeout=60)
         assert not errs, errs
 
-        # every member agrees on the modular 3-member map
-        for m in live:
-            assert m.shard_map == {s: s % 3 for s in range(cfg.n_shards)}
-        assert {s for m in live for s in m.shards} == set(range(cfg.n_shards))
+        # every member agrees on one balanced layout covering all shards
+        layout = _assert_consistent_layout(live, cfg.n_shards)
+        loads = [sum(1 for o in layout.values() if o == m) for m in range(3)]
+        assert max(loads) - min(loads) <= 1, loads
 
         # zero lost, zero duplicated: every acked increment is readable
         # exactly once, from every member's coordinator
@@ -106,14 +130,212 @@ def test_live_join_under_load_then_leave(cfg):
         assert joiner.shards == set()
         vals, _ = nodes[0].read_objects(objs)
         assert (np.asarray(vals, np.int64) == acked).all()
-        for m in ms:
-            assert m.shard_map == {s: s % 2 for s in range(cfg.n_shards)}
+        _assert_consistent_layout(ms, cfg.n_shards)
         # the shrunk cluster still commits
         nodes[1].update_objects([(0, "counter_pn", "b", ("increment", 5))])
         vals, _ = nodes[0].read_objects([(0, "counter_pn", "b")])
         assert vals[0] == int(acked[0]) + 5
     finally:
         for m in live:
+            try:
+                m.close()
+            except Exception:
+                pass
+
+
+def test_live_leave_middle_member_under_load(cfg):
+    """The r5 VERDICT item 3 acceptance shape: member 1 of 3 — a MIDDLE
+    id — live-leaves under write load.  Its shards drain to the
+    least-loaded survivors, the id space keeps its gap (no renumbering),
+    and zero acked ops are lost or duplicated."""
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=3)
+          for i in range(3)]
+    _wire(ms)
+    try:
+        nodes = [ClusterNode(m) for m in ms]
+        n_keys = 24
+        acked = np.zeros(n_keys, np.int64)
+        acked_lock = threading.Lock()
+        stop = threading.Event()
+        errs = []
+
+        def writer(node, seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                k = int(rng.integers(n_keys))
+                try:
+                    node.update_objects(
+                        [(k, "counter_pn", "b", ("increment", 1))])
+                except Exception as e:
+                    if "abort" in str(e).lower():
+                        continue
+                    import traceback
+                    errs.append(traceback.format_exc())
+                    return
+                with acked_lock:
+                    acked[k] += 1
+
+        # drive through members 0 and 2 (the survivors): the leaver's
+        # clients would need re-pointing at a survivor anyway (its
+        # process goes away), exactly like draining a real node
+        ts = [threading.Thread(target=writer, args=(nodes[i], 70 + i))
+              for i in (0, 2, 0)]
+        for t in ts:
+            t.start()
+        time.sleep(1.0)
+
+        before = {s: int(o) for s, o in ms[0].shard_map.items()}
+        moved = live_leave(_rpcs(ms), leaving_id=1)
+        assert moved == len(plan_leave_moves(before, 1)) == 3
+
+        time.sleep(1.0)
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+
+        assert ms[1].shards == set()
+        survivors = [ms[0], ms[2]]
+        layout = _assert_consistent_layout(survivors, cfg.n_shards)
+        assert set(layout.values()) == {0, 2}  # the gap stays a gap
+        # the departed peer is forgotten everywhere
+        for m in survivors:
+            assert 1 not in m.peers
+
+        objs = [(k, "counter_pn", "b") for k in range(n_keys)]
+        for node in (nodes[0], ClusterNode(ms[2])):
+            vals, _ = node.read_objects(objs)
+            got = np.asarray(vals, np.int64)
+            assert (got == acked).all(), (got.tolist(), acked.tolist())
+
+        # the gapped cluster still serves writes on every shard
+        for k in range(cfg.n_shards):
+            nodes[0].update_objects(
+                [(k, "counter_pn", "b", ("increment", 1))])
+        vals, _ = ClusterNode(ms[2]).read_objects(
+            [(k, "counter_pn", "b") for k in range(cfg.n_shards)])
+        assert vals == [int(acked[k]) + 1 for k in range(cfg.n_shards)]
+    finally:
+        for m in ms:
+            try:
+                m.close()
+            except Exception:
+                pass
+
+
+def test_leave_plan_includes_zero_shard_survivors():
+    """A survivor owning nothing is invisible in the shard map but is
+    the least-loaded placement target by definition — the planner must
+    see it (r6 review finding)."""
+    shard_map = {0: 0, 1: 1, 2: 0, 3: 1}
+    moves = plan_leave_moves(shard_map, 1, members={0, 1, 2})
+    assert [dst for _s, _src, dst in moves] == [2, 2]
+    # without the member hint the old occupancy-only behavior remains
+    moves = plan_leave_moves(shard_map, 1)
+    assert all(dst == 0 for _s, _src, dst in moves)
+
+
+def test_departed_id_is_never_reused(cfg):
+    """The id-space bound is monotone EVERYWHERE (m_forget_member,
+    m_set_owner broadcasts, recovery replay): after leaves — including
+    the highest-then-middle sequence whose second drive recomputes a
+    SMALLER bound from its shrunken rpcs map — a join reusing any
+    departed id must be refused; its durable state and the routes
+    remote DCs learned for its fabric id would alias the new member."""
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=3)
+          for i in range(3)]
+    _wire(ms)
+    try:
+        live_leave(_rpcs(ms), leaving_id=2)   # highest id departs...
+        live_leave({0: tuple(ms[0].address),
+                    1: tuple(ms[1].address)}, leaving_id=1)  # ...then mid
+        assert ms[0].n_members == 3  # bound never shrank
+        assert ms[0].departed == {1, 2}  # durable never-reuse set
+        for dead in (1, 2):
+            with pytest.raises(ValueError, match="never be reused"):
+                live_join({0: tuple(ms[0].address),
+                           dead: ("127.0.0.1", 1)}, new_id=dead)
+        # even a reused id the operator already WIRED back into the peer
+        # set (indistinguishable from an interrupted join by liveness
+        # alone) is refused — the durable departed set catches it
+        imposter = ClusterMember(cfg, dc_id=0, member_id=2, n_members=3,
+                                 shards=[])
+        try:
+            ms[0].connect(2, *imposter.address)
+            with pytest.raises(ValueError, match="never be reused"):
+                live_join({0: tuple(ms[0].address),
+                           2: tuple(imposter.address)}, new_id=2)
+        finally:
+            imposter.close()
+            ms[0].peers.pop(2).close()
+        # a genuinely fresh id is welcome (validation passes the bound
+        # check; the dummy address then fails at wiring, which proves
+        # the refusal above came from the bound, not the address)
+        with pytest.raises(Exception,
+                           match="(?i)connect|refused|timed|attempt"):
+            live_join({0: tuple(ms[0].address),
+                       3: ("127.0.0.1", 1)}, new_id=3)
+    finally:
+        for m in ms:
+            m.close()
+
+
+def test_sequencer_cannot_live_leave(cfg):
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=2)
+          for i in range(2)]
+    _wire(ms)
+    try:
+        with pytest.raises(ValueError, match="sequencer"):
+            live_leave(_rpcs(ms), leaving_id=0)
+    finally:
+        for m in ms:
+            m.close()
+
+
+def test_rpcs_must_cover_every_live_member(cfg):
+    """A driver that forgets a live member would half-commit the change
+    (the omitted member never hears the broadcasts); both drivers refuse
+    up front, before any durable mutation."""
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=3)
+          for i in range(3)]
+    _wire(ms)
+    try:
+        partial = {0: tuple(ms[0].address), 1: tuple(ms[1].address)}
+        with pytest.raises(ValueError, match="cover every live member"):
+            live_leave(partial, leaving_id=1)  # member 2 omitted
+        # nothing moved, nothing forgotten
+        assert 2 in ms[0].peers and ms[1].shards
+    finally:
+        for m in ms:
+            m.close()
+
+
+def test_membership_state_survives_log_compaction(cfg, tmp_path):
+    """Prepare-log compaction rewrites the WAL from live state; it must
+    re-emit the membership records (boot_layout + full map/epochs +
+    id-space bound + departed set), or a post-move member would recover
+    with the modular guess of its recover-time count — silently
+    claiming shards it gave away."""
+    dirs = [str(tmp_path / f"m{i}") for i in range(3)]
+    ms = [ClusterMember(cfg, dc_id=0, member_id=i, n_members=3,
+                        log_dir=dirs[i]) for i in range(3)]
+    _wire(ms)
+    try:
+        live_leave(_rpcs(ms), leaving_id=1)
+        m0 = ms[0]
+        before = (set(m0.shards), dict(m0.shard_map),
+                  dict(m0.shard_epoch), set(m0.departed), m0.n_members)
+        m0._compact_prepare_log()
+        m0.close()
+        m0.node.store.log.close()
+        rec = ClusterMember(cfg, dc_id=0, member_id=0, n_members=3,
+                            log_dir=dirs[0], recover=True)
+        ms[0] = rec
+        assert (set(rec.shards), dict(rec.shard_map),
+                dict(rec.shard_epoch), set(rec.departed),
+                rec.n_members) == before
+    finally:
+        for m in ms:
             try:
                 m.close()
             except Exception:
@@ -144,8 +366,8 @@ def test_join_recovers_from_crash_mid_move(cfg, tmp_path):
         # move ONE shard by hand, crashing the exporter before the
         # import lands: two-phase export copied WITHOUT dropping, so the
         # crash destroys nothing
-        moves = plan_moves({s: int(o) for s, (o, _e) in
-                            ms[0].m_shard_map().items()}, 3)
+        moves = plan_join_moves({s: int(o[0]) for s, o in
+                                 ms[0].m_shard_map().items()}, 2)
         shard, src, dst = moves[0]
         data = ms[src].m_export_shard(shard, dst)
         assert shard in ms[src].shards      # still the owner (phase 1)

@@ -9,7 +9,11 @@ Checks (each one has caught a real bug class in this codebase's history):
   * duplicate top-level / class-level function definitions (a paste slip
     silently shadows the first definition);
   * mutable default arguments;
-  * bare ``except:`` (swallows KeyboardInterrupt/SystemExit).
+  * bare ``except:`` (swallows KeyboardInterrupt/SystemExit);
+  * broad except-and-continue inside ``while`` loops (a thread loop
+    that swallows every exception and spins on is a silently-dead
+    subsystem — the failure class the supervised ThreadLoop exists to
+    prevent; surface the error or supervise the loop instead).
 
 Usage: python tools/lint.py [paths...]   (default: antidote_tpu tests
 bench.py bench_suite.py bench_wire.py tpu_smoke.py __graft_entry__.py)
@@ -100,7 +104,46 @@ def check_file(path: str):
         elif isinstance(node, ast.ExceptHandler):
             if node.type is None and not noqa(node.lineno):
                 problems.append(f"{path}:{node.lineno}: bare 'except:'")
+    _check_swallow_loops(tree, path, noqa, problems)
     return problems
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    return h.type is None or (
+        isinstance(h.type, ast.Name)
+        and h.type.id in ("Exception", "BaseException")
+    )
+
+
+def _check_swallow_loops(tree, path, noqa, problems) -> None:
+    """Flag broad ``except``s whose entire body is ``continue`` when the
+    nearest enclosing loop is a ``while`` — the swallow-and-spin shape
+    that turns a crashed thread loop into a silent zombie.  ``for``
+    loops are exempt (bounded retries over peers/attempts), as is any
+    handler that records/raises/logs before continuing."""
+
+    def visit(node, in_while):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ExceptHandler) and in_while:
+                body = [s for s in child.body
+                        if not isinstance(s, ast.Pass)]
+                if (_broad_handler(child) and body
+                        and all(isinstance(s, ast.Continue) for s in body)
+                        and not noqa(child.lineno)):
+                    problems.append(
+                        f"{path}:{child.lineno}: broad except-and-continue "
+                        "inside a while loop (silently swallows every "
+                        "fault forever; surface it or supervise the loop)"
+                    )
+            nw = in_while
+            if isinstance(child, ast.While):
+                nw = True
+            elif isinstance(child, (ast.For, ast.AsyncFor, ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                nw = False  # continue targets the inner loop / new scope
+            visit(child, nw)
+
+    visit(tree, False)
 
 
 def main(argv):
