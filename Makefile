@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -19,6 +19,15 @@ test:
 # crashes — every scenario ends with byte-identical converged snapshots
 chaos:
 	$(PY) -m pytest tests/test_chaos.py -q
+
+# overload smoke (PR 4): the seeded saturation-storm + ENOSPC chaos
+# scenario (typed sheds, bounded RSS, clean read-only entry/exit,
+# byte-identical convergence) plus a short write-plane saturation sweep
+# asserting the structural bounds (typed sheds occur, no latency wedge)
+saturation:
+	$(PY) -m pytest tests/test_overload.py \
+	  "tests/test_chaos.py::test_saturation_storm_enospc_bounded_and_converges" -q
+	$(PY) bench_wire.py --saturation --smoke --assert-bounds
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

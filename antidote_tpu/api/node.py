@@ -204,6 +204,20 @@ class AntidoteNode:
 
         out["net"] = {k: v for k, v in net_metrics().snapshot().items()
                       if v}
+        # overload/degradation view (PR 4): every bound and shed is
+        # visible here and on /metrics — a wedged-looking node should
+        # explain itself from one status call
+        shed = {
+            plane[0]: v
+            for plane, v in sorted(self.metrics.shed.snapshot().items())
+            if v
+        }
+        out["overload"] = {
+            "read_only": self.txm.read_only_reason,
+            "commit_backlog": self.txm._commit_backlog,
+            "max_commit_backlog": self.txm.max_commit_backlog,
+            "shed": shed,
+        }
         if include_ready:
             out["ready"] = self.check_ready()
         return out

@@ -86,6 +86,13 @@ def attach_interdc(member: ClusterMember, fabric, name: str = ""):
     )
     replica.owner_info = lambda shard: (
         member.member_id, int(member.shard_epoch.get(int(shard), 0)))
+    # ingress device applies must exclude this member's readers:
+    # m_read_values gathers from the live table heads under the member
+    # lock only (never the commit lock), and apply_effects donates those
+    # buffers — without this, a read racing an inter-DC drain raises
+    # "Array has been deleted".  Order stays commit lock -> member lock,
+    # the same order m_commit takes (_xlock, _lock).
+    replica.store_lock = member._lock
     member.export_extras.append(replica.export_shard_state)
     member.on_shard_import.append(
         lambda shard, extras: replica.adopt_shard(shard, extras))

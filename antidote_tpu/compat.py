@@ -1,0 +1,42 @@
+"""jax version-compat shims.
+
+The codebase targets the current jax API surface (``jax.shard_map``,
+``jax.enable_x64``), but deployment images may carry an older 0.4.x jax
+where those live under ``jax.experimental`` with slightly different
+spellings (``shard_map(check_rep=...)``, ``enable_x64()``/
+``disable_x64()`` context managers).  Every call site imports the shim
+instead of probing ``jax`` itself, so the supported-version matrix is
+encoded exactly once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` when present, else the ``jax.experimental``
+    form.  ``check_vma`` maps onto the old API's ``check_rep`` (both
+    gate the replication/varying-manual-axes check)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def enable_x64(enable: bool = True):
+    """Context manager toggling x64 mode: ``jax.enable_x64(flag)`` when
+    present, else the paired ``jax.experimental.enable_x64()`` /
+    ``disable_x64()`` managers of 0.4.x."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enable)
+    from jax import experimental as _exp
+
+    return _exp.enable_x64() if enable else _exp.disable_x64()

@@ -128,8 +128,12 @@ def cmd_serve(args) -> int:
 
     def start_proto():
         port = server_box["srv"].port if "srv" in server_box else args.port
-        server_box["srv"] = ProtocolServer(node, host=args.host, port=port,
-                                           interdc=interdc)
+        server_box["srv"] = ProtocolServer(
+            node, host=args.host, port=port, interdc=interdc,
+            max_in_flight=args.max_in_flight,
+            max_in_flight_per_client=args.max_in_flight_per_client,
+            default_deadline_ms=args.default_deadline_ms,
+        )
         return server_box["srv"]
 
     sup.add("proto", start_proto, alive=lambda s: s.is_alive(),
@@ -337,6 +341,18 @@ def main(argv=None) -> int:
                          "expected keyspace — every growth doubling "
                          "reallocates the device tables and recompiles "
                          "all serving shapes")
+    sv.add_argument("--max-in-flight", type=int, default=256,
+                    help="global admitted-request cap; past it the server "
+                         "answers a typed busy error with a retry-after "
+                         "hint instead of queueing")
+    sv.add_argument("--max-in-flight-per-client", type=int, default=64,
+                    help="per-client (peer host) admitted-request cap "
+                         "(keeps one client machine's connection fleet "
+                         "from monopolizing the global budget)")
+    sv.add_argument("--default-deadline-ms", type=float, default=None,
+                    help="server-side deadline for requests that carry no "
+                         "deadline_ms field; work that outlives it is "
+                         "aborted at dequeue (default: no deadline)")
     sv.set_defaults(fn=cmd_serve)
 
     for name, fn in (("status", cmd_status), ("ready", cmd_ready)):

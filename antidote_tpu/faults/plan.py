@@ -24,7 +24,12 @@ log = logging.getLogger(__name__)
 #:   delay    — defer the message one delivery round / sleep arg seconds
 #:   truncate — corrupt the frame to its first ``arg`` bytes
 #:   error    — raise (ConnectionError at transports, IOError at the WAL)
-ACTIONS = ("drop", "dup", "delay", "truncate", "error")
+#:   enospc   — WAL-append site only: raise OSError(errno.ENOSPC) — a
+#:              full disk; drives the node's read-only degraded mode
+#:   io_error — WAL-append site only: raise OSError(errno.EIO) — a
+#:              dying device; same degraded-mode path
+ACTIONS = ("drop", "dup", "delay", "truncate", "error", "enospc",
+           "io_error")
 
 
 class Decision:
@@ -123,6 +128,19 @@ class FaultPlan:
               times: Optional[int] = None, message: str = "injected fault"
               ) -> "FaultPlan":
         return self.add(site, "error", key, p, times, arg=message)
+
+    def enospc(self, site: str = "wal.append", key=None, p: float = 1.0,
+               times: Optional[int] = None) -> "FaultPlan":
+        """Full-disk injection on the WAL append path: the site raises
+        ``OSError(errno.ENOSPC)``, flipping the node into read-only
+        degraded mode until the rule stops firing (auto-recovery)."""
+        return self.add(site, "enospc", key, p, times)
+
+    def io_error(self, site: str = "wal.append", key=None, p: float = 1.0,
+                 times: Optional[int] = None) -> "FaultPlan":
+        """Dying-device injection on the WAL append path
+        (``OSError(errno.EIO)``); same degraded-mode path as enospc."""
+        return self.add(site, "io_error", key, p, times)
 
 
 class FaultInjector:

@@ -46,6 +46,15 @@ class DCReplica:
     #: whichever first; pumps flush lazily whenever commits are pending
     HEARTBEAT_INTERVAL_S = 1.0
     HEARTBEAT_EVERY_COMMITS = 64
+    #: ingress high-water marks (PR 4).  GATE_HWM caps one (origin,
+    #: shard) chain's causal-gate queue: past it, delivery is SHED
+    #: without advancing ``last_seen`` — the chain gap that opens is
+    #: exactly what the opid catch-up repairs, so pressure converts into
+    #: repair traffic instead of memory.  PENDING_HWM caps the
+    #: out-of-order buffer the same way (anything dropped is above
+    #: ``last_seen`` and gets refetched).
+    GATE_HWM = 1024
+    PENDING_HWM = 256
 
     def __init__(self, node: AntidoteNode, hub: LoopbackHub, name: str = "",
                  shards=None, fabric_id: int = None):
@@ -97,6 +106,15 @@ class DCReplica:
         import threading
 
         self._sent_lock = threading.Lock()
+        #: held (AFTER the commit lock — the documented cross-plane
+        #: order) around the batched device apply.  ``apply_effects`` is
+        #: a read-modify-REASSIGN with buffer donation, so a reader
+        #: gathering from the live heads concurrently observes deleted
+        #: jax buffers.  ``attach_interdc`` re-points this at the
+        #: cluster member's lock — the lock ``m_read_values`` reads
+        #: under — closing the ingress-vs-reader race (own commits were
+        #: already excluded via the commit lock).
+        self.store_lock = threading.RLock()
         self._commits_since_hb = 0
         self._last_hb = time.monotonic()
         #: per-shard safe time last pinged (drives the tick-path flush)
@@ -104,10 +122,12 @@ class DCReplica:
         #: ingress: last delivered opid per (origin, shard)
         self.last_seen: Dict[Tuple[int, int], int] = {}
         #: ingress: out-of-order buffer per (origin, shard)
+        # bounded-by: PENDING_HWM (checked at every insert in _on_message)
         self.pending: Dict[Tuple[int, int], List[TxnMessage]] = (
             collections.defaultdict(list)
         )
         #: causal gate FIFO per (origin, shard)
+        # bounded-by: GATE_HWM (shed-at-accept in _accept/_flush_pending)
         self.gate: Dict[Tuple[int, int], collections.deque] = (
             collections.defaultdict(collections.deque)
         )
@@ -409,6 +429,14 @@ class DCReplica:
         # every fabric pump (maybe_heartbeat via the tick), mirroring the
         # reference's 1 s timer.
         self._commits_since_hb += 1
+        if getattr(self.node.txm, "_publishing_group", False):
+            # mid-group publish: later group members' counters are
+            # already minted but their messages are not on the stream
+            # yet, so a safe-time ping here would make subscribers skip
+            # them as duplicates (lost effects).  The tick-path flush
+            # (maybe_heartbeat at every pump) or the next commit sends
+            # the deferred ping instead.
+            return
         if (self._commits_since_hb >= self.HEARTBEAT_EVERY_COMMITS
                 or time.monotonic() - self._last_hb
                 >= self.HEARTBEAT_INTERVAL_S):
@@ -612,8 +640,14 @@ class DCReplica:
                 return
             elif msg.prev_opid > last:
                 # gap: buffer and query the origin's log reader (the
-                # catch-up's pending flush integrates this message)
-                self.pending[key].append(msg)
+                # catch-up's pending flush integrates this message).
+                # BOUNDED: past the high-water mark the message is shed —
+                # it sits above last_seen, so the still-open gap makes
+                # catch-up refetch it once the buffer drains
+                if len(self.pending[key]) >= self.PENDING_HWM:
+                    self._shed_ingress(key, "pending")
+                else:
+                    self.pending[key].append(msg)
                 catchup_from = last
             else:
                 return  # duplicate — drop
@@ -695,7 +729,34 @@ class DCReplica:
                     self._accept(key, m)
             self._flush_pending(key)
 
+    def _shed_ingress(self, key, where: str) -> None:
+        """Count + (throttled) log one shed ingress message.  The shed
+        NEVER advances ``last_seen``, so it is indistinguishable from a
+        lossy link: the publisher's next chain message re-reveals the
+        gap and catch-up replays the loss once pressure drains — shed is
+        deferral into the repair path, not data loss.  The publisher
+        sees the pressure as catch-up queries against its log (plus the
+        antidote_interdc_ingress_shed_total counter here)."""
+        from antidote_tpu.obs.metrics import net_metrics
+
+        net_metrics().ingress_shed.inc()
+        now = time.monotonic()
+        if now - getattr(self, "_last_shed_log", 0.0) > 5.0:
+            self._last_shed_log = now
+            log.warning("ingress gate for chain %s past its %s high-water "
+                        "mark; shedding (catch-up will refill)", key, where)
+
+    def _gate_full(self, key) -> bool:
+        q = self.gate.get(key)
+        return q is not None and len(q) >= self.GATE_HWM
+
     def _accept(self, key, msg: TxnMessage) -> None:
+        # BOUNDED gate: a chain at its high-water mark (dep-blocked head
+        # under a delivery storm) sheds instead of queueing — last_seen
+        # stays put, so the skipped suffix returns through catch-up
+        if self._gate_full(key):
+            self._shed_ingress(key, "gate")
+            return
         self.last_seen[key] = msg.last_opid
         self._queue(msg)
         self._flush_pending(key)
@@ -703,7 +764,8 @@ class DCReplica:
     def _flush_pending(self, key) -> None:
         """Drain the out-of-order buffer: one pass over the buffer sorted
         by chain position (the old repeated-rescan was O(n²), r2 VERDICT
-        weak #6)."""
+        weak #6).  Stops flushing (keeps the tail buffered) once the gate
+        hits its high-water mark — same bound as _accept."""
         buf = self.pending.get(key)
         if not buf:
             return
@@ -711,11 +773,11 @@ class DCReplica:
         keep: List[TxnMessage] = []
         for m in buf:
             last = self.last_seen.get(key, 0)
-            if m.prev_opid == last:
+            if m.prev_opid == last and not self._gate_full(key):
                 self.last_seen[key] = m.last_opid
                 self._queue(m)
             elif m.last_opid > last:
-                keep.append(m)  # still a gap ahead of it
+                keep.append(m)  # still a gap ahead of it (or gate full)
             # else: duplicate — drop
         self.pending[key] = keep
 
@@ -723,7 +785,18 @@ class DCReplica:
     # causal dependency gate
     # ------------------------------------------------------------------
     def _queue(self, msg: TxnMessage) -> None:
-        self.gate[(msg.origin, msg.shard)].append(msg)
+        q = self.gate[(msg.origin, msg.shard)]
+        if msg.is_ping and q and q[-1].is_ping:
+            # coalesce trailing pings: per-chain ping timestamps are
+            # monotone and the drain only reads the LAST one, so a
+            # blocked head accumulates at most one parked ping instead
+            # of one per heartbeat interval
+            q[-1] = msg
+            return
+        q.append(msg)
+        # gate-depth gauge refresh is the drain path's job (_drain_gates
+        # runs on every delivery pump): an O(#chains) sum per enqueued
+        # message would tax the hot ingress path for a gauge
 
     def _drain_gates(self) -> None:
         """Apply every gated txn whose dependencies are satisfied; loop
@@ -751,6 +824,9 @@ class DCReplica:
         excluded via the endpoint lock."""
         with self.node.txm.commit_lock:
             self._drain_gates_locked()
+            if self.node.metrics is not None:
+                self.node.metrics.interdc_gate_depth.set(
+                    sum(len(g) for g in self.gate.values()))
 
     def _drain_gates_locked(self) -> None:
         store = self.node.store
@@ -839,7 +915,8 @@ class DCReplica:
                 # messages are consumed from the queues only AFTER the
                 # apply succeeds — an exception leaves everything queued
                 # for the next drain instead of silently dropping txns
-                store.apply_effects(effects, vcs, origins)
+                with self.store_lock:
+                    store.apply_effects(effects, vcs, origins)
             for gk, n in taken.items():
                 q = self.gate[gk]
                 for _ in range(n):

@@ -81,6 +81,63 @@ def _hard_close(sock) -> None:
         pass
 
 
+class _Subscriber:
+    """One subscription stream's sender side: a BOUNDED outbox drained
+    by a dedicated writer thread.
+
+    The old design wrote frames synchronously from ``push()`` under a
+    per-stream lock — one stalled subscriber could park the publisher's
+    COMMIT path for the full SO_SNDTIMEO window, and the kernel socket
+    buffer was the only bound.  Now ``push()`` never blocks: past the
+    outbox cap the frame is dropped for THIS subscriber only (counted in
+    ``antidote_interdc_egress_window_drops_total``) and the subscriber
+    heals through the opid-gap catch-up path — the same repair that
+    covers a severed link, so a lagging peer costs a bounded outbox, not
+    unbounded publisher memory."""
+
+    #: frames parked per lagging subscriber before drops begin; sized so
+    #: a normal pump hiccup (GC pause, one slow device launch) rides
+    #: through, while a wedged peer caps out in ~1 MB of small frames
+    OUTBOX_MAX = 1024
+    _CLOSE = object()
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.outbox: "queue.Queue" = queue.Queue(maxsize=self.OUTBOX_MAX)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, on_dead: Callable[["_Subscriber"], None]) -> None:
+        self._thread = threading.Thread(
+            target=self._writer, args=(on_dead,), daemon=True,
+            name=f"interdc-egress:{self.sock.fileno()}")
+        self._thread.start()
+
+    def _writer(self, on_dead) -> None:
+        while True:
+            data = self.outbox.get()
+            if data is self._CLOSE:
+                return
+            try:
+                _send(self.sock, K_PUSH, data)
+            except OSError:  # dead, or stalled past SO_SNDTIMEO
+                on_dead(self)
+                return
+
+    def offer(self, data: bytes) -> bool:
+        """Queue one frame; False = outbox full, frame dropped."""
+        try:
+            self.outbox.put_nowait(data)
+            return True
+        except queue.Full:
+            return False
+
+    def stop(self) -> None:
+        try:
+            self.outbox.put_nowait(self._CLOSE)
+        except queue.Full:
+            pass  # writer will exit on the closed socket's send error
+
+
 class _Endpoint:
     """One DC's listening side: accepts subscriber streams and queries."""
 
@@ -90,9 +147,9 @@ class _Endpoint:
         self.lock = threading.RLock()          # guards handler invocations
         self.query_handler: Optional[Callable] = None
         self.request_handler: Optional[Callable] = None
-        #: (socket, per-connection write lock) — the write lock serializes
-        #: frames on one stream; _subs_lock guards only list membership
-        self._subs: List[Tuple[socket.socket, threading.Lock]] = []
+        #: live subscription streams (each a _Subscriber with its own
+        #: bounded outbox + writer thread); _subs_lock guards membership
+        self._subs: List[_Subscriber] = []
         self._subs_lock = threading.Lock()
         #: live query/request connections (server side): close() must
         #: shut these down too, or a killed endpoint would keep serving
@@ -107,41 +164,38 @@ class _Endpoint:
                 except (ConnectionError, OSError):
                     return
                 if kind == K_SUB:
-                    # a send-only timeout (SO_SNDTIMEO) bounds how long one
-                    # stalled subscriber can hold its write lock; reads
+                    # a send-only timeout (SO_SNDTIMEO) bounds how long
+                    # one stalled subscriber can wedge its WRITER THREAD
+                    # (not the publisher — push() never blocks); reads
                     # (the park loop below) are unaffected
                     self.request.setsockopt(
                         socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                         struct.pack("ll", 10, 0),
                     )
-                    # register + ack while holding this connection's write
-                    # lock: a concurrent push that snapshots the list right
-                    # after registration blocks on the lock until the ack
-                    # frame is fully out — so the ack is always the stream's
-                    # first frame, and once subscribe() returns every later
-                    # publish sees the socket (observe_dcs_sync semantics,
+                    # register BEFORE the ack, start the writer AFTER it:
+                    # a publish racing registration only enqueues, and the
+                    # outbox preserves order — so the ack is always the
+                    # stream's first frame, and once subscribe() returns
+                    # every later publish sees the subscriber
+                    # (observe_dcs_sync semantics,
                     # /root/reference/src/inter_dc_manager.erl:209-230)
-                    wlock = threading.Lock()
-                    entry = (self.request, wlock)
-                    with wlock:
-                        with ep._subs_lock:
-                            ep._subs.append(entry)
-                        try:
-                            _send(self.request, K_REPLY, "subscribed")
-                        except OSError:
-                            with ep._subs_lock:
-                                if entry in ep._subs:
-                                    ep._subs.remove(entry)
-                            return
+                    entry = _Subscriber(self.request)
+                    with ep._subs_lock:
+                        ep._subs.append(entry)
+                    try:
+                        _send(self.request, K_REPLY, "subscribed")
+                    except OSError:
+                        ep._drop_sub(entry)
+                        return
+                    entry.start(ep._drop_sub)
                     # park until the peer closes (reads detect EOF)
                     try:
                         while self.request.recv(1):
                             pass
                     except OSError:
                         pass
-                    with ep._subs_lock:
-                        if entry in ep._subs:
-                            ep._subs.remove(entry)
+                    ep._drop_sub(entry)
+                    entry.stop()
                     return
                 # query connection: serve request/reply until EOF
                 with ep._subs_lock:
@@ -192,22 +246,25 @@ class _Endpoint:
                 return self.request_handler(body["kind"], body["payload"])
         raise ValueError(f"unknown frame kind {kind}")
 
+    def _drop_sub(self, entry: _Subscriber) -> None:
+        with self._subs_lock:
+            if entry in self._subs:
+                self._subs.remove(entry)
+        try:
+            entry.sock.close()
+        except OSError:
+            pass
+
     def push(self, data: bytes) -> None:
+        """Fan one frame out to every subscriber WITHOUT blocking: each
+        stream has a bounded outbox drained by its own writer thread.  A
+        full outbox (lagging subscriber) drops the frame for that stream
+        only — its opid-gap catch-up replays the loss from the log."""
         with self._subs_lock:
             conns = list(self._subs)
         for entry in conns:
-            c, wlock = entry
-            try:
-                with wlock:  # one writer per stream; frames never interleave
-                    _send(c, K_PUSH, data)
-            except OSError:  # dead or stalled past SO_SNDTIMEO: drop it
-                with self._subs_lock:
-                    if entry in self._subs:
-                        self._subs.remove(entry)
-                try:
-                    c.close()
-                except OSError:
-                    pass
+            if not entry.offer(data):
+                net_metrics().egress_window_drops.inc()
 
     def close(self) -> None:
         self._server.shutdown()
@@ -216,8 +273,9 @@ class _Endpoint:
             # _hard_close, not close(): the park/serve threads are
             # blocked in recv() on these sockets, and a bare close never
             # sends the FIN that tells subscribers the stream died
-            for c, _ in self._subs:
-                _hard_close(c)
+            for s in self._subs:
+                _hard_close(s.sock)
+                s.stop()
             self._subs.clear()
             for c in list(self._queries):
                 _hard_close(c)
@@ -233,6 +291,10 @@ class TcpFabric:
     inter_dc_manager:observe_dcs_sync,
     /root/reference/src/inter_dc_manager.erl:67-109).
     """
+
+    #: inbox high-water mark (frames parked for pump()); past it the
+    #: Python readers shed — see ``inbox`` below
+    INBOX_MAX = 16384
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  public_host: Optional[str] = None, reconnect: bool = True,
@@ -266,8 +328,12 @@ class TcpFabric:
         self.addresses: Dict[int, Tuple[str, int]] = {}
         #: dc_id -> (host, port) to put in exported descriptors
         self.advertised: Dict[int, Tuple[str, int]] = {}
-        #: subscriber-side inbox: (deliver, data) pairs await pump()
-        self.inbox: "queue.Queue" = queue.Queue()
+        #: subscriber-side inbox: (deliver, data) pairs await pump().
+        #: BOUNDED — when the pump falls this far behind, readers shed
+        #: the newest frames instead of buffering without limit; the
+        #: per-chain opid gap the shed opens is closed by catch-up once
+        #: the pump drains (antidote_interdc_ingress_shed_total counts)
+        self.inbox: "queue.Queue" = queue.Queue(maxsize=self.INBOX_MAX)
         self._readers: List[threading.Thread] = []
         self._closed = False
         #: jitter source for reconnect backoff (NOT the fault plan's rng)
@@ -292,6 +358,8 @@ class TcpFabric:
         self._np_tags: Dict[int, Callable] = {}
         self._np_next = 1
         #: decoded frames awaiting delivery (batch drains outpace pump)
+        # bounded-by: one native take_batch crossing (≤512 frames),
+        # consumed before the next crossing in _get_message
         self._np_ready: "collections.deque" = collections.deque()
         self._query_conns: Dict[Tuple[int, int], socket.socket] = {}
         self._query_lock = threading.Lock()
@@ -438,7 +506,12 @@ class TcpFabric:
                 while True:
                     kind, body = _recv(sock)
                     if kind == K_PUSH:
-                        self.inbox.put((deliver, bytes(body)))
+                        try:
+                            self.inbox.put_nowait((deliver, bytes(body)))
+                        except queue.Full:
+                            # pump saturated: shed, the chain gap heals
+                            # via catch-up once the pump drains
+                            net_metrics().ingress_shed.inc()
             except (ConnectionError, OSError):
                 pass
             try:
@@ -501,8 +574,13 @@ class TcpFabric:
                         return
                     if d.action == "delay":
                         # redeliver in a later pump round (reordering);
-                        # the rule decides again on the retry
-                        self.inbox.put((deliver, data))
+                        # the rule decides again on the retry.  A full
+                        # inbox degrades the delay to a drop — both are
+                        # faults the chain repair already covers
+                        try:
+                            self.inbox.put_nowait((deliver, data))
+                        except queue.Full:
+                            net_metrics().ingress_shed.inc()
                         return
                     if d.action == "truncate":
                         data = data[: int(d.arg or 4)]

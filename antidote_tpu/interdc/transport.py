@@ -35,6 +35,8 @@ class LoopbackHub:
         #: reference's 1 s timer,
         #: /root/reference/src/inter_dc_log_sender_vnode.erl:188-204)
         self.ticks: Dict[int, Callable[[], None]] = {}
+        # bounded-by: test-only deterministic transport — pump() drains
+        # to quiescence every round, no wire to fall behind
         self.queues: collections.deque = collections.deque()
         #: (from_dc, to_dc) pairs whose next N messages are dropped
         self.drop: Dict[Tuple[int, int], int] = {}
@@ -59,6 +61,7 @@ class LoopbackHub:
             self.subscribers[pub] = [
                 (to_dc, cb) for to_dc, cb in subs if to_dc != dc_id
             ]
+        # bounded-by: rebuilt from the (test-only, pump-drained) queue
         self.queues = collections.deque(
             (to_dc, cb, data) for to_dc, cb, data in self.queues
             if to_dc != dc_id

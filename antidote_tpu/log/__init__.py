@@ -162,11 +162,13 @@ class LogManager:
         #: blob handles already persisted per shard (avoid re-writing bytes)
         self._blob_seen = [set() for _ in range(cfg.n_shards)]
 
-    def log_effect(self, shard: int, key, type_name: str, bucket: str,
-                   eff_a: np.ndarray, eff_b: np.ndarray, commit_vc, origin: int,
-                   blob_refs=()) -> int:
-        """Append one effect record; returns its op-id in the
-        (shard, origin) chain."""
+    def _append_one(self, shard: int, key, type_name: str, bucket: str,
+                    eff_a, eff_b, commit_vc, origin: int,
+                    blob_refs) -> Tuple[int, List[int]]:
+        """Append one record; a failed append rolls the op-id chain and
+        blob-dedup memory back (the WAL itself heals its torn frame), so
+        a refused write never leaves a permanent op-id GAP for egress to
+        publish.  Returns (opid, blob hashes first seen here)."""
         self.op_ids[shard, origin] += 1
         opid = int(self.op_ids[shard, origin])
         blobs = [
@@ -174,20 +176,71 @@ class LogManager:
             for h, data in blob_refs
             if h not in self._blob_seen[shard]
         ]
-        for h, _ in blobs:
+        new_hashes = [h for h, _ in blobs]
+        for h in new_hashes:
             self._blob_seen[shard].add(h)
-        self.wals[shard].append({
-            "k": key,
-            "b": bucket,
-            "t": type_name,
-            "a": np.asarray(eff_a, np.int64).tobytes(),
-            "eb": np.asarray(eff_b, np.int32).tobytes(),
-            "vc": [int(x) for x in np.asarray(commit_vc)],
-            "o": int(origin),
-            "id": opid,
-            "bl": blobs,
-        })
+        try:
+            self.wals[shard].append({
+                "k": key,
+                "b": bucket,
+                "t": type_name,
+                "a": np.asarray(eff_a, np.int64).tobytes(),
+                "eb": np.asarray(eff_b, np.int32).tobytes(),
+                "vc": [int(x) for x in np.asarray(commit_vc)],
+                "o": int(origin),
+                "id": opid,
+                "bl": blobs,
+            })
+        except BaseException:
+            self.op_ids[shard, origin] -= 1
+            for h in new_hashes:
+                self._blob_seen[shard].discard(h)
+            raise
+        return opid, new_hashes
+
+    def log_effect(self, shard: int, key, type_name: str, bucket: str,
+                   eff_a: np.ndarray, eff_b: np.ndarray, commit_vc, origin: int,
+                   blob_refs=()) -> int:
+        """Append one effect record; returns its op-id in the
+        (shard, origin) chain."""
+        opid, _ = self._append_one(shard, key, type_name, bucket,
+                                   eff_a, eff_b, commit_vc, origin, blob_refs)
         return opid
+
+    def log_effects(self, entries) -> None:
+        """Append one commit group's records, atomically with respect to
+        FAILURE: an OSError on a later record (ENOSPC mid-group) rolls
+        every touched WAL, op-id chain and blob-dedup entry back to the
+        pre-group state.  Without this, a NACKed group left a durable
+        prefix that recovery replay resurrected — writes the clients
+        were told failed came back locally (and were never published
+        inter-DC, so DCs diverged).
+
+        ``entries``: iterable of ``log_effect`` argument tuples
+        ``(shard, key, type_name, bucket, eff_a, eff_b, commit_vc,
+        origin, blob_refs)``."""
+        offs: Dict[int, int] = {}
+        op_snap = self.op_ids.copy()
+        added: List[Tuple[int, int]] = []  # (shard, blob hash) logged
+        try:
+            for (shard, key, tname, bucket, ea, eb, vc, origin,
+                 brefs) in entries:
+                if shard not in offs:
+                    offs[shard] = self.wals[shard].tell()
+                _, new_hashes = self._append_one(
+                    shard, key, tname, bucket, ea, eb, vc, origin, brefs)
+                added.extend((shard, h) for h in new_hashes)
+        except BaseException:
+            for s, off in offs.items():
+                try:
+                    self.wals[s].rollback_to(off)
+                except OSError:
+                    pass  # the disk is failing; replay's CRC guard
+                    # still stops at whatever half-frame remains
+            self.op_ids[:] = op_snap
+            for s, h in added:
+                self._blob_seen[s].discard(h)
+            raise
 
     def set_sync(self, sync: bool) -> None:
         """Runtime fsync-on-commit toggle (logging_vnode:set_sync_log,
@@ -198,6 +251,15 @@ class LogManager:
     def commit_barrier(self, shards) -> None:
         for p in set(int(s) for s in shards):
             self.wals[p].commit()
+
+    def probe_append(self) -> None:
+        """Raise while ANY shard's WAL appends would still fail
+        (degraded-mode recovery probe — see ShardWAL.probe).  Every
+        shard is probed: a failure scoped to one file (bad block,
+        per-file fault rule) must keep the node read-only, not flap it
+        out on a healthy sibling's success."""
+        for w in self.wals:
+            w.probe()
 
     def truncate_shard(self, shard: int) -> None:
         """Discard one shard's log (post-handoff cleanup: the records now

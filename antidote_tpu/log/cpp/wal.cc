@@ -161,6 +161,25 @@ int64_t wal_size(void* handle) {
   return static_cast<int64_t>(w->appended_bytes.load());
 }
 
+// Real end-of-file offset — includes any torn bytes a failed append left
+// behind (appended_bytes only counts SUCCESSFUL appends this session),
+// so a caller-saved tell() is a valid rollback point.
+int64_t wal_tell(void* handle) {
+  Wal* w = static_cast<Wal*>(handle);
+  off_t end = ::lseek(w->fd, 0, SEEK_END);
+  if (end < 0) return -1;
+  return static_cast<int64_t>(end);
+}
+
+// Roll the file back to `off`: a failed group's records and any torn
+// tail are discarded.  Shrinking allocates no blocks, so this works on
+// the very full disk that made the append fail.
+int wal_truncate(void* handle, int64_t off) {
+  Wal* w = static_cast<Wal*>(handle);
+  if (::ftruncate(w->fd, static_cast<off_t>(off)) != 0) return -1;
+  return 0;
+}
+
 void wal_close(void* handle) { delete static_cast<Wal*>(handle); }
 
 }  // extern "C"

@@ -14,6 +14,7 @@ Python fallback keeps the API working where no compiler exists.
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
 import struct
 import subprocess
@@ -47,7 +48,9 @@ def _load_lib():
                  str(_SRC), "-o", str(_SO)],
                 check=True, capture_output=True,
             )
-        lib = ctypes.CDLL(str(_SO))
+        # use_errno: a failed append/commit must surface WHICH OS error
+        # (ENOSPC vs EIO vs ...) — the read-only degraded mode keys off it
+        lib = ctypes.CDLL(str(_SO), use_errno=True)
         lib.wal_open.restype = ctypes.c_void_p
         lib.wal_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.wal_append.restype = ctypes.c_int64
@@ -59,6 +62,10 @@ def _load_lib():
         lib.wal_sync.argtypes = [ctypes.c_void_p]
         lib.wal_set_sync.restype = None
         lib.wal_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.wal_tell.restype = ctypes.c_int64
+        lib.wal_tell.argtypes = [ctypes.c_void_p]
+        lib.wal_truncate.restype = ctypes.c_int
+        lib.wal_truncate.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.wal_close.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception:
@@ -90,13 +97,25 @@ class ShardWAL:
         return self._h is not None
 
     def _faulted_append(self) -> None:
-        """Fault site "wal.append" (key = file basename): error raises
-        IOError before anything hits the file — the caller sees exactly
-        what a full disk / dead device produces; delay sleeps in the
-        append path (a stalling volume)."""
+        """Fault site "wal.append" (key = file basename): error/enospc/
+        io_error raise before anything hits the file — the caller sees
+        exactly what a full disk / dead device produces; delay sleeps in
+        the append path (a stalling volume)."""
         d = faults.hit("wal.append", key=os.path.basename(self.path))
         if d is None:
             return
+        if d.action == "enospc":
+            raise OSError(
+                errno.ENOSPC,
+                f"injected fault: wal.append {self.path}: "
+                "No space left on device",
+            )
+        if d.action == "io_error":
+            raise OSError(
+                errno.EIO,
+                f"injected fault: wal.append {self.path}: "
+                "Input/output error",
+            )
         if d.action == "error":
             raise IOError(f"injected fault: wal.append {self.path}: {d.arg}")
         if d.action == "delay" and d.arg:
@@ -106,14 +125,50 @@ class ShardWAL:
         if faults.get_injector() is not None:
             self._faulted_append()
         payload = msgpack.packb(record, use_bin_type=True)
+        start = self.tell()
+        try:
+            if self._h is not None:
+                ctypes.set_errno(0)
+                n = self._lib.wal_append(self._h, payload, len(payload))
+                if n < 0:
+                    raise self._native_oserror("wal_append")
+            else:
+                self._f.write(_HDR.pack(_MAGIC, len(payload),
+                                        zlib.crc32(payload) & 0xFFFFFFFF))
+                self._f.write(payload)
+        except BaseException:
+            # a partially-written frame must not stay on disk: replay
+            # stops at the first torn record, so torn bytes followed by
+            # LATER successful appends would silently hide those appends
+            # from recovery.  Best-effort — shrinking needs no blocks.
+            try:
+                self.rollback_to(start)
+            except OSError:
+                pass
+            raise
+
+    def tell(self) -> int:
+        """Current end-of-file offset (a rollback point for
+        :meth:`rollback_to`)."""
         if self._h is not None:
-            n = self._lib.wal_append(self._h, payload, len(payload))
+            n = self._lib.wal_tell(self._h)
             if n < 0:
-                raise IOError(f"wal_append failed for {self.path}")
-        else:
-            self._f.write(_HDR.pack(_MAGIC, len(payload),
-                                    zlib.crc32(payload) & 0xFFFFFFFF))
-            self._f.write(payload)
+                raise self._native_oserror("wal_tell")
+            return int(n)
+        self._f.flush()
+        return os.fstat(self._f.fileno()).st_size
+
+    def rollback_to(self, off: int) -> None:
+        """Discard everything appended past ``off`` (failed-group
+        rollback; works on a full disk — truncation frees, never
+        allocates)."""
+        if self._h is not None:
+            ctypes.set_errno(0)
+            if self._lib.wal_truncate(self._h, int(off)) != 0:
+                raise self._native_oserror("wal_truncate")
+            return
+        self._f.flush()
+        self._f.truncate(off)
 
     def set_sync(self, sync: bool) -> None:
         """Runtime fsync-on-commit toggle, honored by both backends."""
@@ -121,10 +176,21 @@ class ShardWAL:
         if self._h is not None:
             self._lib.wal_set_sync(self._h, int(sync))
 
+    def _native_oserror(self, fn: str) -> OSError:
+        """OSError carrying the native call's errno (the C side returns
+        -1 with errno set).  A real full disk must look exactly like the
+        injected one — errno is what flips the read-only degraded mode;
+        0 (lost/overwritten errno) degrades to EIO so the commit still
+        fails typed rather than with an errno-less IOError."""
+        err = ctypes.get_errno() or errno.EIO
+        return OSError(err, f"{fn} failed for {self.path}: "
+                            f"{os.strerror(err)}")
+
     def commit(self) -> None:
         if self._h is not None:
+            ctypes.set_errno(0)
             if self._lib.wal_commit(self._h) != 0:
-                raise IOError(f"wal_commit failed for {self.path}")
+                raise self._native_oserror("wal_commit")
         else:
             self._f.flush()
             if self.sync_on_commit:
@@ -136,6 +202,28 @@ class ShardWAL:
         else:
             self._f.flush()
             os.fsync(self._f.fileno())
+
+    def probe(self) -> None:
+        """Raise while appends would still fail; no-op once they can
+        succeed again (the read-only degraded mode's auto-recovery
+        probe).  Consults the same fault site as :meth:`append` (an
+        injected ENOSPC keeps the probe failing until the rule stops
+        firing), then proves the volume with a real, fsynced sidecar
+        write — NOT an append to the log itself, which would poison
+        replay with a non-effect record."""
+        if faults.get_injector() is not None:
+            self._faulted_append()
+        p = self.path + ".probe"
+        try:
+            with open(p, "wb") as f:
+                f.write(b"\0" * 4096)
+                f.flush()
+                os.fsync(f.fileno())
+        finally:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def close(self) -> None:
         if self._h is not None:

@@ -16,7 +16,8 @@ tests run on the CPU mesh (tests/conftest.py) and on the real chip.
 
 The package enables x64 globally (i64 payload lanes); Mosaic lowering wants
 i32 index arithmetic, so every kernel invocation runs under
-``jax.enable_x64(False)`` — all kernel operands are i32 by construction.
+``enable_x64(False)`` (the compat shim) — all kernel operands are i32
+by construction.
 """
 
 from __future__ import annotations
@@ -28,7 +29,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from antidote_tpu.compat import enable_x64
+
 _I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _x64_off():
+    """x64 off for a HOST-LEVEL kernel entry; a no-op while tracing.
+    The kernels are dtype-pinned (explicit i32 literals/accumulators), so
+    correctness never depends on this — but flipping the config inside an
+    outer trace desyncs the inner jit's traced signature from the outer
+    trace's operands ('func.call op operand type mismatch'), so the
+    context must not be entered when a caller (typed_table's fused reads,
+    sets.resolve) is already tracing."""
+    import contextlib
+
+    if not jax.core.trace_state_clean():
+        return contextlib.nullcontext()
+    return enable_x64(False)
 
 
 def _on_tpu() -> bool:
@@ -65,11 +83,18 @@ def _counter_fold_kernel(deltas_ref, ops_vc_ref, n_ops_ref, base_vc_ref,
         visible = visible & (vd <= read_vc_ref[:, dd:dd + 1])
     slots = jax.lax.broadcasted_iota(jnp.int32, v0.shape, 1)
     include = (~in_base) & visible & (slots < n_ops_ref[:])  # [BLK, K]
+    # dtype-pinned sums: integer reductions accumulate at the DEFAULT int
+    # width, so under an x64 trace (typed_table calls this from inside its
+    # own jit, where no enable_x64(False) context can apply) the results
+    # would silently become i64 and fail the i32 out_shape
+    zero = jnp.int32(0)
     cnt_ref[:] = jnp.sum(
-        jnp.where(include, deltas_ref[:], 0), axis=1, keepdims=True
+        jnp.where(include, deltas_ref[:], zero), axis=1, keepdims=True,
+        dtype=jnp.int32,
     )
     applied_ref[:] = jnp.sum(
-        jnp.where(include, 1, 0), axis=1, keepdims=True
+        jnp.where(include, jnp.int32(1), zero), axis=1, keepdims=True,
+        dtype=jnp.int32,
     )
 
 
@@ -86,10 +111,9 @@ def _counter_fold_call(deltas, ops_vc, n_ops, base_vc, read_vc,
     d = ops_vc.shape[-1]
     ops_vc = jnp.transpose(ops_vc, (2, 0, 1))      # [D, B, K]
     grid = (b // block,)
-    with jax.enable_x64(False):
-        cnt, applied = _counter_fold_pallas(deltas, ops_vc, n_ops, base_vc,
-                                            read_vc, b, k, d, grid, block,
-                                            interpret)
+    cnt, applied = _counter_fold_pallas(deltas, ops_vc, n_ops, base_vc,
+                                        read_vc, b, k, d, grid, block,
+                                        interpret)
     return cnt[:b0, 0], applied[:b0, 0]
 
 
@@ -147,11 +171,13 @@ def counter_fold(base_cnt, deltas, ops_vc, n_ops, base_vc, read_vc,
             f"kernel sum over a {k}-slot ring; use fold.fold_batch for "
             "this workload"
         )
-    dcnt, applied = _counter_fold_call(
-        jnp.asarray(deltas, jnp.int32), jnp.asarray(ops_vc, jnp.int32),
-        jnp.asarray(n_ops, jnp.int32), jnp.asarray(base_vc, jnp.int32),
-        jnp.asarray(read_vc, jnp.int32), block, interpret,
-    )
+    # x64 off OUTSIDE the jit (host entries only — see _x64_off)
+    with _x64_off():
+        dcnt, applied = _counter_fold_call(
+            jnp.asarray(deltas, jnp.int32), jnp.asarray(ops_vc, jnp.int32),
+            jnp.asarray(n_ops, jnp.int32), jnp.asarray(base_vc, jnp.int32),
+            jnp.asarray(read_vc, jnp.int32), block, interpret,
+        )
     return jnp.asarray(base_cnt, jnp.int64) + dcnt.astype(jnp.int64), applied
 
 
@@ -174,15 +200,14 @@ def _stable_min_kernel(clocks_ref, out_ref):
 def _stable_min_call(clocks, block: int, interpret: bool):
     clocks = _pad_to(clocks, block, 0, fill=_I32_MAX)
     n, d = clocks.shape
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            _stable_min_kernel,
-            grid=(n // block,),
-            in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((1, d), lambda i: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((1, d), jnp.int32),
-            interpret=interpret,
-        )(clocks)
+    out = pl.pallas_call(
+        _stable_min_kernel,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.int32),
+        interpret=interpret,
+    )(clocks)
     return out[0]
 
 def stable_min(clocks, block: int = 512, interpret: bool | None = None):
@@ -198,7 +223,8 @@ def stable_min(clocks, block: int = 512, interpret: bool | None = None):
     clocks = jnp.asarray(clocks, jnp.int32)
     if clocks.shape[0] == 0:
         return jnp.full((clocks.shape[1],), _I32_MAX, jnp.int32)
-    return _stable_min_call(clocks, block, interpret)
+    with _x64_off():  # i32 trace default (see counter_fold)
+        return _stable_min_call(clocks, block, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +237,8 @@ def _presence_kernel(addvc_ref, rmvc_ref, elems_lo_ref, out_ref):
     for dd in range(1, d):
         present = present | (addvc_ref[dd] > rmvc_ref[dd])
     present = present & (elems_lo_ref[:] != 0)
-    out_ref[:] = jnp.where(present, 1, 0)
+    # dtype-pinned (not weak-literal where): see _counter_fold_kernel
+    out_ref[:] = present.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
@@ -223,19 +250,18 @@ def _presence_call(addvc, rmvc, elems_lo, block: int, interpret: bool):
     b, e, d = addvc.shape
     addvc = jnp.transpose(addvc, (2, 0, 1))        # [D, B, E]
     rmvc = jnp.transpose(rmvc, (2, 0, 1))
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            _presence_kernel,
-            grid=(b // block,),
-            in_specs=[
-                pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
-                pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
-                pl.BlockSpec((block, e), lambda i: (i, 0)),
-            ],
-            out_specs=pl.BlockSpec((block, e), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((b, e), jnp.int32),
-            interpret=interpret,
-        )(addvc, rmvc, elems_lo)
+    out = pl.pallas_call(
+        _presence_kernel,
+        grid=(b // block,),
+        in_specs=[
+            pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((d, block, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((block, e), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, e), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, e), jnp.int32),
+        interpret=interpret,
+    )(addvc, rmvc, elems_lo)
     return out[:b0]
 
 
@@ -251,7 +277,8 @@ def orset_presence(addvc, rmvc, elems_lo, block: int = 256,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    return _presence_call(
-        jnp.asarray(addvc, jnp.int32), jnp.asarray(rmvc, jnp.int32),
-        jnp.asarray(elems_lo, jnp.int32), block, interpret,
-    )
+    with _x64_off():  # i32 trace default (see counter_fold)
+        return _presence_call(
+            jnp.asarray(addvc, jnp.int32), jnp.asarray(rmvc, jnp.int32),
+            jnp.asarray(elems_lo, jnp.int32), block, interpret,
+        )

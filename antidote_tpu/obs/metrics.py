@@ -46,6 +46,13 @@ class Counter:
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
         return self._values.get(key, 0.0)
 
+    def snapshot(self) -> Dict[Tuple[str, ...], float]:
+        """Locked copy of label-tuple -> value (readers must not iterate
+        ``_values`` live: a concurrent first inc() of a new label set
+        inserts a key mid-iteration)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:  # the HTTP server scrapes from another thread
@@ -228,13 +235,24 @@ class NetMetrics:
             "Inter-DC catch-up routes re-pointed at a new shard owner "
             "via ownership-epoch gossip"
         )
+        self.egress_window_drops = Counter(
+            "antidote_interdc_egress_window_drops_total",
+            "Egress frames dropped for lagging subscribers (bounded "
+            "outbox overflow; the subscriber heals via opid-gap catch-up)"
+        )
+        self.ingress_shed = Counter(
+            "antidote_interdc_ingress_shed_total",
+            "Ingress txn messages shed past the gate/pending high-water "
+            "mark (chain position NOT advanced; catch-up refills)"
+        )
 
     def all_metrics(self):
         return (self.reconnects, self.reconnect_attempts,
                 self.corrupt_frames, self.catchup_failures,
                 self.rpc_retries, self.rpc_deadline_exceeded,
                 self.faults_injected, self.pump_fallback,
-                self.shard_moves, self.route_updates)
+                self.shard_moves, self.route_updates,
+                self.egress_window_drops, self.ingress_shed)
 
     def attach(self, registry: "MetricsRegistry") -> None:
         """Register the shared counter objects into a node registry so
@@ -296,6 +314,42 @@ class NodeMetrics:
         self.commit_batch_size = r.histogram(
             "antidote_commit_batch_size", "Effects per commit batch",
             buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384),
+        )
+        # overload/backpressure plane (PR 4): every bound, shed, and
+        # degraded-mode flip is observable
+        self.shed = r.counter(
+            "antidote_shed_total",
+            "Requests shed by overload protection, by plane "
+            "(server | server_queue | txn | deadline | read_only)",
+            ("plane",),
+        )
+        self.in_flight = r.gauge(
+            "antidote_server_in_flight",
+            "Wire-server requests currently admitted (AdmissionGate)",
+        )
+        self.commit_gate_depth = r.gauge(
+            "antidote_commit_gate_depth",
+            "Static batch-gate queue depth (requests parked for the "
+            "next group launch)",
+        )
+        self.interdc_gate_depth = r.gauge(
+            "antidote_interdc_gate_depth",
+            "Remote txns queued in the causal dependency gates",
+        )
+        self.degraded_read_only = r.gauge(
+            "antidote_degraded_read_only",
+            "1 while the node is in degraded read-only mode (WAL "
+            "appends failing), else 0",
+        )
+        self.server_request_seconds = r.histogram(
+            "antidote_server_request_seconds",
+            "Wire-server request latency, admission to reply (s)",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+        )
+        self.commit_seconds = r.histogram(
+            "antidote_commit_seconds",
+            "Commit-group latency inside the commit lock (s)",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
         )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)

@@ -1,0 +1,141 @@
+"""Overload protection primitives: typed shed errors + admission gates.
+
+The backpressure vocabulary every plane shares (the riak_core analogue:
+vnode overload protection + OTP mailbox discipline — a saturated vnode
+answers ``{error, overload}`` instead of queueing unboundedly).  Three
+rules, applied at the wire server, the commit gate, and the WAL:
+
+  * **bounded everything** — every queue has a cap; past it, work is
+    refused with a typed error, never parked forever;
+  * **honest busy errors** — a shed request gets an explicit reply with
+    a retry-after hint; silent drops are reserved for planes with a
+    built-in repair path (the inter-DC opid-gap catch-up);
+  * **deadlines** — a request that outlived its caller is aborted at
+    dequeue, not executed (its reply would be garbage-collected anyway).
+
+All three error types are raised server-side and surface on the wire as
+distinguishable error replies (proto/server.py maps them; the client
+raises the ``Remote*`` twins in proto/client.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class BusyError(Exception):
+    """Admission refused: the plane is at its in-flight/backlog cap.
+
+    ``retry_after_ms`` is the server's hint for client backoff (the
+    apb dialect carries it inside the errmsg text)."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class DeadlineExceeded(Exception):
+    """The request outlived its client-supplied (or configured default)
+    deadline before execution started — aborted at dequeue."""
+
+
+class ReadOnlyError(Exception):
+    """The node is in degraded read-only mode (WAL appends failing —
+    ENOSPC/IO error); writes are rejected, reads keep serving.  The mode
+    exits automatically once an append probe succeeds again."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"node is read-only (degraded): {reason}")
+        self.reason = reason
+
+
+def deadline_from_ms(deadline_ms, default_ms=None) -> Optional[float]:
+    """Absolute monotonic deadline from a client-supplied relative ms
+    budget (``None`` falls back to the configured default, which may
+    itself be None = no deadline)."""
+    if deadline_ms is None:
+        deadline_ms = default_ms
+    if deadline_ms is None:
+        return None
+    return time.monotonic() + float(deadline_ms) / 1e3
+
+
+def check_deadline(deadline: Optional[float], where: str) -> None:
+    if deadline is not None and time.monotonic() > deadline:
+        raise DeadlineExceeded(
+            f"request deadline passed before {where}; not executed"
+        )
+
+
+class AdmissionGate:
+    """Global + per-client in-flight caps for the wire server.
+
+    ``enter`` admits or raises :class:`BusyError`; callers MUST pair it
+    with ``exit`` (try/finally).  ``client_id`` is an opaque key — the
+    wire server passes the PEER HOST, so the cap bounds one client
+    machine's whole connection fleet (each connection's handler thread
+    is serial, so per-socket in-flight never exceeds 1; per-host is the
+    accounting that actually stops a greedy client from monopolizing
+    the global budget)."""
+
+    def __init__(self, max_in_flight: int = 256, max_per_client: int = 64,
+                 gauge=None):
+        self.max_in_flight = int(max_in_flight)
+        self.max_per_client = int(max_per_client)
+        self._lock = threading.Lock()
+        self._total = 0
+        self._per_client: Dict[object, int] = {}
+        #: refusals since the last successful admission — the depth
+        #: signal behind the retry hint (``_total`` itself never
+        #: exceeds the cap, so it carries no pressure information)
+        self._shed_streak = 0
+        #: optional obs Gauge mirroring ``self._total``
+        self._gauge = gauge
+
+    def enter(self, client_id) -> None:
+        with self._lock:
+            if self._total >= self.max_in_flight:
+                raise BusyError(
+                    f"server at max_in_flight={self.max_in_flight}",
+                    retry_after_ms=self._retry_hint_locked(),
+                )
+            if self._per_client.get(client_id, 0) >= self.max_per_client:
+                raise BusyError(
+                    f"client {client_id} at max_in_flight_per_client="
+                    f"{self.max_per_client}",
+                    retry_after_ms=self._retry_hint_locked(),
+                )
+            self._total += 1
+            self._shed_streak = 0
+            self._per_client[client_id] = (
+                self._per_client.get(client_id, 0) + 1)
+            if self._gauge is not None:
+                self._gauge.set(self._total)
+
+    def exit(self, client_id) -> None:
+        with self._lock:
+            self._total -= 1
+            n = self._per_client.get(client_id, 0) - 1
+            if n <= 0:
+                self._per_client.pop(client_id, None)
+            else:
+                self._per_client[client_id] = n
+            if self._gauge is not None:
+                self._gauge.set(self._total)
+
+    def in_flight(self) -> int:
+        return self._total
+
+    def _retry_hint_locked(self) -> int:
+        # pressure-scaled hint: refusals since the last successful
+        # admission measure how deep the overload runs — back off
+        # harder the longer the pool has stayed full (bounded
+        # 25..500 ms)
+        self._shed_streak += 1
+        return max(25, min(500, 25 * (1 + self._shed_streak // 4)))
+
+
+__all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
+           "AdmissionGate", "deadline_from_ms", "check_deadline"]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from antidote_tpu.compat import shard_map
 from antidote_tpu.store.typed_table import _shard_read_body
 
 SHARD_AXIS = "shard"
@@ -101,7 +102,7 @@ def sharded_step_fn(ty, cfg, mesh: Mesh):
     spec = P(SHARD_AXIS)
     n_in = 17
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(spec,) * n_in,

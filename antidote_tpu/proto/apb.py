@@ -419,6 +419,26 @@ def _error(msg: str) -> bytes:
     })
 
 
+def _overload_text(e) -> str:
+    """Typed overload error text: proto2 ApbErrorResp has no structured
+    retry field, so the kind + retry-after hint ride the errmsg prefix
+    ("busy retry_after_ms=NN: ..."), which antidotec_pb clients surface
+    verbatim."""
+    from antidote_tpu.overload import BusyError, DeadlineExceeded
+
+    if isinstance(e, BusyError):
+        return f"busy retry_after_ms={int(e.retry_after_ms)}: {e}"
+    if isinstance(e, DeadlineExceeded):
+        return f"deadline: {e}"
+    return f"read_only: {e}"
+
+
+def overload_error(kind: str, msg: str, retry_after_ms: int = 0) -> bytes:
+    """Pre-dispatch overload reply frame (the server's admission shed)."""
+    hint = f" retry_after_ms={int(retry_after_ms)}" if retry_after_ms else ""
+    return _error(f"{kind}{hint}: {msg}")
+
+
 def handle_request(server, code: int, payload: bytes, conn_txns: set,
                    lock=None) -> bytes:
     """Dispatch one apb request; returns the response frame body (code
@@ -452,18 +472,25 @@ def handle_request(server, code: int, payload: bytes, conn_txns: set,
 def _dispatch_static(server, name: str, req: Dict[str, Any]):
     node = server.node
     my_dc = getattr(node, "dc_id", 0)
+    # proto2 ApbStaticRead/Update carry no deadline field, but the
+    # server's configured default still applies: parked apb work that
+    # outlives it is aborted at the batch-gate dequeue like any other
+    from antidote_tpu.overload import deadline_from_ms
+
+    deadline = deadline_from_ms(None, server.default_deadline_ms)
     try:
         if name == "ApbStaticUpdateObjects":
             clock = _dec_clock(req["transaction"].get("timestamp"))
             vc = server.static_update(
-                updates_from_update_ops(req.get("updates", []), my_dc), clock
+                updates_from_update_ops(req.get("updates", []), my_dc),
+                clock, deadline=deadline,
             )
             return "ApbCommitResp", {
                 "success": True, "commit_time": _enc_clock(vc),
             }
         clock = _dec_clock(req["transaction"].get("timestamp"))
         objs = [_bound_object(bo) for bo in req.get("objects", [])]
-        vals, vc = server.static_read(objs, clock)
+        vals, vc = server.static_read(objs, clock, deadline=deadline)
         return "ApbStaticReadObjectsResp", {
             "objects": {
                 "success": True,
@@ -475,6 +502,13 @@ def _dispatch_static(server, name: str, req: Dict[str, Any]):
             "committime": {"success": True, "commit_time": _enc_clock(vc)},
         }
     except Exception as e:
+        from antidote_tpu.overload import (BusyError, DeadlineExceeded,
+                                           ReadOnlyError)
+
+        if isinstance(e, (BusyError, DeadlineExceeded, ReadOnlyError)):
+            return "ApbErrorResp", {
+                "errmsg": to_bytes(_overload_text(e)), "errcode": 0,
+            }
         return "ApbErrorResp", {
             "errmsg": to_bytes(f"{type(e).__name__}: {e}"), "errcode": 0,
         }
@@ -528,12 +562,27 @@ def _dispatch(server, name: str, req: Dict[str, Any],
                 raise
             return "ApbOperationResp", {"success": True}
         if name == "ApbCommitTransaction":
+            from antidote_tpu.overload import BusyError
+
             txid = int(req["transaction_descriptor"])
-            txn = server._txns.pop(txid, None)
-            conn_txns.discard(txid)
+            txn = server._txns.get(txid)
             if txn is None:
                 raise KeyError("unknown transaction")
-            vc = node.commit_transaction(txn)
+            # keep the txn registered until the outcome is known: a
+            # commit-backlog BusyError leaves it OPEN (the shed happens
+            # before the group touches it), so the busy errmsg's retry
+            # hint is honest — the SAME descriptor can be resubmitted
+            # (mirrors the native dialect's COMMIT_TRANSACTION)
+            try:
+                vc = node.commit_transaction(txn)
+            except BusyError:
+                raise
+            except BaseException:
+                server._txns.pop(txid, None)  # txn is dead
+                conn_txns.discard(txid)
+                raise
+            server._txns.pop(txid, None)
+            conn_txns.discard(txid)
             return "ApbCommitResp", {
                 "success": True, "commit_time": _enc_clock(vc),
             }
@@ -567,6 +616,13 @@ def _dispatch(server, name: str, req: Dict[str, Any],
             "errmsg": to_bytes(f"unhandled apb request {name}"), "errcode": 0,
         }
     except Exception as e:  # mirror the reference's catch-all error reply
+        from antidote_tpu.overload import (BusyError, DeadlineExceeded,
+                                           ReadOnlyError)
+
+        if isinstance(e, (BusyError, DeadlineExceeded, ReadOnlyError)):
+            return "ApbErrorResp", {
+                "errmsg": to_bytes(_overload_text(e)), "errcode": 0,
+            }
         return "ApbErrorResp", {
             "errmsg": to_bytes(f"{type(e).__name__}: {e}"), "errcode": 0,
         }

@@ -28,6 +28,25 @@ class RemoteError(Exception):
     """Server-side error reply."""
 
 
+class RemoteBusy(RemoteError):
+    """Server shed the request (overload admission / bounded queue).
+    ``retry_after_ms`` is the server's backoff hint."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class RemoteDeadline(RemoteError):
+    """The request outlived its deadline server-side; it was aborted at
+    dequeue — never executed."""
+
+
+class RemoteReadOnly(RemoteError):
+    """The node is in degraded read-only mode (WAL appends failing);
+    writes are rejected, reads keep serving."""
+
+
 class ClientTxn:
     def __init__(self, client: "AntidoteClient", txid: int):
         self._client = client
@@ -66,9 +85,17 @@ class AntidoteClient:
             write_message(self._sock, code, body)
             resp_code, resp = decode(read_frame(self._sock))
         if resp_code == MessageCode.ERROR_RESP:
-            if resp.get("error") == "aborted":
+            err = resp.get("error")
+            if err == "aborted":
                 raise RemoteAbort(resp.get("detail", ""))
-            raise RemoteError(f"{resp.get('error')}: {resp.get('detail')}")
+            if err == "busy":
+                raise RemoteBusy(resp.get("detail", ""),
+                                 int(resp.get("retry_after_ms", 50)))
+            if err == "deadline":
+                raise RemoteDeadline(resp.get("detail", ""))
+            if err == "read_only":
+                raise RemoteReadOnly(resp.get("detail", ""))
+            raise RemoteError(f"{err}: {resp.get('detail')}")
         return resp
 
     # ------------------------------------------------------------------
@@ -81,19 +108,29 @@ class AntidoteClient:
         return ClientTxn(self, body["txid"])
 
     def update_objects(self, updates: Sequence[Tuple],
-                       clock: Optional[Sequence[int]] = None) -> List[int]:
-        body = self._call(MessageCode.STATIC_UPDATE_OBJECTS, {
+                       clock: Optional[Sequence[int]] = None,
+                       deadline_ms: Optional[float] = None) -> List[int]:
+        req = {
             "updates": list(updates),
             "clock": None if clock is None else [int(x) for x in clock],
-        })
+        }
+        if deadline_ms is not None:
+            # relative budget; the server aborts the request at dequeue
+            # once it has outlived this (RemoteDeadline reply)
+            req["deadline_ms"] = float(deadline_ms)
+        body = self._call(MessageCode.STATIC_UPDATE_OBJECTS, req)
         return body["commit_clock"]
 
     def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
-                     clock: Optional[Sequence[int]] = None):
-        body = self._call(MessageCode.STATIC_READ_OBJECTS, {
+                     clock: Optional[Sequence[int]] = None,
+                     deadline_ms: Optional[float] = None):
+        req = {
             "objects": list(objects),
             "clock": None if clock is None else [int(x) for x in clock],
-        })
+        }
+        if deadline_ms is not None:
+            req["deadline_ms"] = float(deadline_ms)
+        body = self._call(MessageCode.STATIC_READ_OBJECTS, req)
         return ([decode_value(v) for v in body["values"]],
                 body["commit_clock"])
 

@@ -15,15 +15,25 @@ matching the single-writer-per-partition design (SURVEY §2.10 row 2).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import socketserver
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.overload import (
+    AdmissionGate,
+    BusyError,
+    DeadlineExceeded,
+    ReadOnlyError,
+    check_deadline,
+    deadline_from_ms,
+)
 from antidote_tpu.proto import apb
 from antidote_tpu.proto.codec import (
     MessageCode,
@@ -46,9 +56,10 @@ class _StaticWork:
     """One client's static read/update parked at the batch gate."""
 
     __slots__ = ("kind", "objects", "updates", "clock", "event", "result",
-                 "error")
+                 "error", "deadline")
 
-    def __init__(self, kind, objects=None, updates=None, clock=None):
+    def __init__(self, kind, objects=None, updates=None, clock=None,
+                 deadline=None):
         self.kind = kind
         self.objects = objects
         self.updates = updates
@@ -56,6 +67,10 @@ class _StaticWork:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        #: absolute monotonic deadline (None = none): checked when the
+        #: batch dispatcher DEQUEUES the work — a request that outlived
+        #: its caller while parked is aborted, not executed
+        self.deadline: Optional[float] = deadline
 
 
 def _decode_objects(objs):
@@ -74,12 +89,41 @@ def _vc(x) -> Optional[np.ndarray]:
 class ProtocolServer:
     def __init__(self, node: AntidoteNode, host: str = "127.0.0.1",
                  port: int = 0, interdc=None, max_connections: int = 1024,
-                 batch_static: bool = True):
+                 batch_static: bool = True, max_in_flight: int = 256,
+                 max_in_flight_per_client: int = 64, queue_max: int = 4096,
+                 default_deadline_ms: Optional[float] = None):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
         self._lock = threading.Lock()
         self._txns: Dict[int, Transaction] = {}
+        #: metric sink for the overload planes: the node's own registry
+        #: when it has one; a ClusterNode facade exposes its member's
+        #: (one registry per process either way)
+        self.metrics = getattr(node, "metrics", None)
+        if self.metrics is None:
+            inner = getattr(getattr(node, "member", None), "node", None)
+            self.metrics = getattr(inner, "metrics", None)
+        if self.metrics is None:
+            from antidote_tpu.obs import NodeMetrics
+
+            self.metrics = NodeMetrics()
+        #: overload admission (PR 4): global + per-client (peer host)
+        #: in-flight caps.  Past a cap, the request is answered with a
+        #: typed busy error carrying a retry-after hint — never parked
+        #: forever (the riak_core vnode overload answer, {error,
+        #: overload}).  Per-HOST, not per-socket: each connection's
+        #: handler thread is serial, so per-socket in-flight never
+        #: exceeds 1 — bounding a client machine's whole connection
+        #: fleet is what actually prevents monopolization
+        self.admission = AdmissionGate(
+            max_in_flight, max_in_flight_per_client,
+            gauge=self.metrics.in_flight,
+        )
+        #: default per-request deadline (ms) when the client sends none;
+        #: None = requests without a deadline_ms field never expire
+        self.default_deadline_ms = default_deadline_ms
+        self._conn_ids = itertools.count(1)
         #: cross-connection batch gate (r4 VERDICT item 3): static
         #: reads/updates from concurrent connections coalesce into single
         #: device launches instead of one launch per socket — the wire
@@ -88,7 +132,10 @@ class ProtocolServer:
         #: partition, /root/reference/include/antidote.hrl:28)
         self.batch_static = batch_static
         self._closing = False
-        self._static_q: "queue.Queue" = queue.Queue()
+        #: BOUNDED: a full gate answers busy instead of buffering without
+        #: limit (admission usually sheds first; this cap is the backstop
+        #: against a stalled dispatcher)
+        self._static_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         self._batch_max = 1024
         if batch_static:
             self._batcher = threading.Thread(
@@ -165,48 +212,112 @@ class ProtocolServer:
                         server_self._abort_orphan(txid)
 
             def _serve(self, conn_txns):
+                # admission key = peer host: one client machine's whole
+                # connection fleet shares one per-client budget
+                try:
+                    client_id = self.request.getpeername()[0]
+                except OSError:
+                    client_id = f"conn{next(server_self._conn_ids)}"
+                metrics = server_self.metrics
                 while True:
                     try:
                         frame = read_frame(self.request)
                     except (ConnectionError, OSError):
                         return
-                    # dialect dispatch on the code byte: antidote_pb
-                    # request codes (apb.APB_REQUEST_CODES) are disjoint
-                    # from the native msgpack codes, so existing
-                    # antidotec_pb clients connect to the same port
-                    if frame and frame[0] in apb.APB_REQUEST_CODES:
-                        resp_body = apb.handle_request(
-                            server_self, frame[0], frame[1:], conn_txns,
-                            lock=server_self._lock,
-                        )
-                        try:
-                            write_frame_body(self.request, resp_body)
-                        except (ConnectionError, OSError):
+                    # ADMISSION (PR 4): acquire an in-flight slot before
+                    # any decode/dispatch work.  Past the global or
+                    # per-client cap the request is answered with a
+                    # typed busy error + retry-after hint — the client
+                    # backs off, the server never queues unboundedly.
+                    t0 = time.monotonic()
+                    try:
+                        server_self.admission.enter(client_id)
+                    except BusyError as e:
+                        metrics.shed.inc(plane="server")
+                        if not self._reply_error(frame, "busy", e):
                             return
                         continue
                     try:
-                        code, body = decode(frame)
-                        resp_code, resp = server_self._process(code, body)
-                        if code == MessageCode.START_TRANSACTION:
-                            conn_txns.add(resp["txid"])
-                        elif code in (MessageCode.COMMIT_TRANSACTION,
-                                      MessageCode.ABORT_TRANSACTION):
-                            conn_txns.discard(body.get("txid"))
-                    except AbortError as e:
-                        if code == MessageCode.UPDATE_OBJECTS:
-                            conn_txns.discard(body.get("txid"))
-                        resp_code, resp = MessageCode.ERROR_RESP, {
-                            "error": "aborted", "detail": str(e)
-                        }
-                    except Exception as e:  # error reply, keep the conn
-                        log.exception("request failed")
-                        resp_code, resp = MessageCode.ERROR_RESP, {
-                            "error": type(e).__name__, "detail": str(e)
-                        }
+                        if not self._handle_admitted(frame, conn_txns):
+                            return
+                    finally:
+                        server_self.admission.exit(client_id)
+                        metrics.server_request_seconds.observe(
+                            time.monotonic() - t0)
+
+            def _reply_error(self, frame, kind: str, e) -> bool:
+                """Typed error reply in the FRAME'S dialect; False when
+                the connection died mid-write."""
+                retry_ms = int(getattr(e, "retry_after_ms", 0))
+                try:
+                    if frame and frame[0] in apb.APB_REQUEST_CODES:
+                        write_frame_body(self.request, apb.overload_error(
+                            kind, str(e), retry_ms))
+                    else:
+                        resp = {"error": kind, "detail": str(e)}
+                        if retry_ms:
+                            resp["retry_after_ms"] = retry_ms
+                        write_message(self.request,
+                                      MessageCode.ERROR_RESP, resp)
+                    return True
+                except (ConnectionError, OSError):
+                    return False
+
+            def _handle_admitted(self, frame, conn_txns) -> bool:
+                """One admitted request end-to-end; False = drop conn."""
+                # dialect dispatch on the code byte: antidote_pb
+                # request codes (apb.APB_REQUEST_CODES) are disjoint
+                # from the native msgpack codes, so existing
+                # antidotec_pb clients connect to the same port
+                if frame and frame[0] in apb.APB_REQUEST_CODES:
+                    resp_body = apb.handle_request(
+                        server_self, frame[0], frame[1:], conn_txns,
+                        lock=server_self._lock,
+                    )
                     try:
-                        write_message(self.request, resp_code, resp)
+                        write_frame_body(self.request, resp_body)
                     except (ConnectionError, OSError):
-                        return
+                        return False
+                    return True
+                try:
+                    code, body = decode(frame)
+                    resp_code, resp = server_self._process(code, body)
+                    if code == MessageCode.START_TRANSACTION:
+                        conn_txns.add(resp["txid"])
+                    elif code in (MessageCode.COMMIT_TRANSACTION,
+                                  MessageCode.ABORT_TRANSACTION):
+                        conn_txns.discard(body.get("txid"))
+                except AbortError as e:
+                    if code == MessageCode.UPDATE_OBJECTS:
+                        conn_txns.discard(body.get("txid"))
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "aborted", "detail": str(e)
+                    }
+                except BusyError as e:
+                    # downstream cap (commit backlog / batch gate):
+                    # same typed shape as the admission shed
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "busy", "detail": str(e),
+                        "retry_after_ms": int(e.retry_after_ms),
+                    }
+                except DeadlineExceeded as e:
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "deadline", "detail": str(e)
+                    }
+                except ReadOnlyError as e:
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "read_only", "detail": str(e)
+                    }
+                except Exception as e:  # error reply, keep the conn
+                    log.exception("request failed")
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": type(e).__name__, "detail": str(e)
+                    }
+                try:
+                    write_message(self.request, resp_code, resp)
+                except (ConnectionError, OSError):
+                    return False
+                return True
 
         return Handler
 
@@ -220,26 +331,40 @@ class ProtocolServer:
     # ------------------------------------------------------------------
     # static batch gate
     # ------------------------------------------------------------------
-    def static_read(self, objects, clock):
+    def static_read(self, objects, clock, deadline=None):
         """Batched static read: (values, snapshot_vc)."""
         if not self.batch_static:
             with self._lock:
+                check_deadline(deadline, "dispatch")
                 return self.node.read_objects(objects, clock=_vc(clock))
         return self._submit(_StaticWork("read", objects=objects,
-                                        clock=_vc(clock)))
+                                        clock=_vc(clock),
+                                        deadline=deadline))
 
-    def static_update(self, updates, clock):
+    def static_update(self, updates, clock, deadline=None):
         """Batched static update: commit VC (raises AbortError on cert)."""
         if not self.batch_static:
             with self._lock:
+                check_deadline(deadline, "dispatch")
                 return self.node.update_objects(updates, clock=_vc(clock))
         return self._submit(_StaticWork("update", updates=updates,
-                                        clock=_vc(clock)))
+                                        clock=_vc(clock),
+                                        deadline=deadline))
 
     def _submit(self, work: _StaticWork):
         if self._closing:
             raise ConnectionError("server shutting down")
-        self._static_q.put(work)
+        try:
+            # bounded gate: shed with a typed busy error instead of
+            # parking behind an unbounded backlog
+            self._static_q.put_nowait(work)
+        except queue.Full:
+            self.metrics.shed.inc(plane="server_queue")
+            raise BusyError(
+                f"static batch gate full ({self._static_q.maxsize} "
+                "requests parked)", retry_after_ms=100,
+            ) from None
+        self.metrics.commit_gate_depth.set(self._static_q.qsize())
         if not work.event.wait(timeout=300):
             raise TimeoutError("static batch dispatcher stalled")
         if work.error is not None:
@@ -263,6 +388,21 @@ class ProtocolServer:
                     break
             stop = any(w is _STOP for w in batch)
             works: List[_StaticWork] = [w for w in batch if w is not _STOP]
+            self.metrics.commit_gate_depth.set(q.qsize())
+            # deadline discipline: work that outlived its caller while
+            # parked is aborted AT DEQUEUE — executing it would burn a
+            # device launch on a reply nobody is waiting for
+            live: List[_StaticWork] = []
+            for w in works:
+                if w.deadline is not None and time.monotonic() > w.deadline:
+                    self.metrics.shed.inc(plane="deadline")
+                    w.error = DeadlineExceeded(
+                        "request deadline passed while parked at the "
+                        "batch gate; not executed")
+                    w.event.set()
+                else:
+                    live.append(w)
+            works = live
             try:
                 ups = [w for w in works if w.kind == "update"]
                 reads = [w for w in works if w.kind == "read"]
@@ -405,6 +545,17 @@ class ProtocolServer:
         while pending:
             staged = []
             for w in pending:
+                # re-check per-work deadlines at every retry round: a
+                # conflict-retry loop under load must not keep executing
+                # work whose caller has already timed out
+                if (w.deadline is not None
+                        and time.monotonic() > w.deadline):
+                    self.metrics.shed.inc(plane="deadline")
+                    w.error = DeadlineExceeded(
+                        "request deadline passed before commit; "
+                        "not executed")
+                    w.event.set()
+                    continue
                 try:
                     txn = txm.start_transaction(w.clock)
                     try:
@@ -421,7 +572,13 @@ class ProtocolServer:
             try:
                 outs = txm.commit_transactions_group([t for _, t in staged])
             except Exception as e:
-                for w, _ in staged:
+                # a backlog-shed group comes back with its txns still
+                # OPEN (retryable for interactive holders) — but these
+                # txns are server-created and the static clients only
+                # ever see the error reply, so abort them here
+                for w, txn in staged:
+                    if txn.active:
+                        txm.abort_transaction(txn)
                     w.error = e
                     w.event.set()
                 return
@@ -439,13 +596,21 @@ class ProtocolServer:
 
     # ------------------------------------------------------------------
     def _process(self, code: MessageCode, body: Any):
+        # per-request deadline: client-supplied relative ``deadline_ms``
+        # (native dialect only), else the configured server default.
+        # Work that outlives it while queued is aborted at dequeue.
+        deadline = deadline_from_ms(
+            body.get("deadline_ms") if isinstance(body, dict) else None,
+            self.default_deadline_ms,
+        )
         # static ops route through the gate helpers OUTSIDE the lock (the
         # gate's dispatcher takes it; with batching off they lock inline)
         # — the ONLY static dispatch path, so it cannot drift from a
         # duplicate
         if code == MessageCode.STATIC_READ_OBJECTS:
             vals, vc = self.static_read(
-                _decode_objects(body["objects"]), body.get("clock")
+                _decode_objects(body["objects"]), body.get("clock"),
+                deadline=deadline,
             )
             return MessageCode.READ_OBJECTS_RESP, {
                 "values": [encode_value(v) for v in vals],
@@ -453,12 +618,20 @@ class ProtocolServer:
             }
         if code == MessageCode.STATIC_UPDATE_OBJECTS:
             vc = self.static_update(
-                _decode_updates(body["updates"]), body.get("clock")
+                _decode_updates(body["updates"]), body.get("clock"),
+                deadline=deadline,
             )
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
             }
         with self._lock:
+            # deadline re-checked at dequeue (= after the lock convoy):
+            # a request that outlived its caller is not executed
+            try:
+                check_deadline(deadline, "dispatch")
+            except DeadlineExceeded:
+                self.metrics.shed.inc(plane="deadline")
+                raise
             return self._dispatch(code, body)
 
     def _dispatch(self, code: MessageCode, body: Any):
@@ -484,8 +657,19 @@ class ProtocolServer:
                 raise
             return MessageCode.OPERATION_RESP, {"ok": True}
         if code == MessageCode.COMMIT_TRANSACTION:
-            txn = self._txns.pop(body["txid"])
-            commit_vc = node.commit_transaction(txn)
+            # keep the txn registered until the outcome is known: a
+            # commit-backlog BusyError leaves it OPEN (the shed happens
+            # before the group touches it), so the busy reply's retry
+            # hint is honest — the SAME commit can be resubmitted
+            txn = self._txn(body["txid"])
+            try:
+                commit_vc = node.commit_transaction(txn)
+            except BusyError:
+                raise
+            except BaseException:
+                self._txns.pop(body["txid"], None)  # txn is dead
+                raise
+            self._txns.pop(body["txid"], None)
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in commit_vc]
             }
@@ -504,11 +688,19 @@ class ProtocolServer:
             self._create_dc(body.get("nodes", []))
             return MessageCode.OPERATION_RESP, {"ok": True}
         if code == MessageCode.NODE_STATUS:
-            return MessageCode.OPERATION_RESP, {
-                "status": node.status(
-                    include_ready=bool(body.get("include_ready"))
-                )
-            }
+            status = node.status(
+                include_ready=bool(body.get("include_ready"))
+            )
+            # the server's own admission plane rides along (the node
+            # object can't see it)
+            status.setdefault("overload", {}).update({
+                "in_flight": self.admission.in_flight(),
+                "max_in_flight": self.admission.max_in_flight,
+                "max_in_flight_per_client": self.admission.max_per_client,
+                "batch_gate_depth": self._static_q.qsize(),
+                "batch_gate_max": self._static_q.maxsize,
+            })
+            return MessageCode.OPERATION_RESP, {"status": status}
         raise ValueError(f"unhandled message code {code!r}")
 
     def _txn(self, txid: int) -> Transaction:
@@ -556,6 +748,16 @@ class ProtocolServer:
         self._server.shutdown()
         self._server.server_close()
         if self.batch_static:
-            self._static_q.put(_STOP)
+            # the gate is bounded now: a full queue + wedged dispatcher
+            # must not turn close() into a forever-blocking put
+            stop_by = time.monotonic() + 5.0
+            while True:
+                try:
+                    self._static_q.put_nowait(_STOP)
+                    break
+                except queue.Full:
+                    if time.monotonic() >= stop_by:
+                        break  # dispatcher wedged; it is a daemon thread
+                    time.sleep(0.05)
             self._batcher.join(timeout=5)
         self._thread.join(timeout=5)

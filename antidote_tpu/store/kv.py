@@ -383,6 +383,7 @@ class KVStore:
         by_table: Dict[str, list] = {}
         touched = []
         inval: List[Tuple[Any, str]] = []
+        to_log: List[tuple] = []
         for i, eff in enumerate(effects):
             tname_t, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
             inval.append((eff.key, eff.bucket))
@@ -395,16 +396,21 @@ class KVStore:
             for h, data in eff.blob_refs:
                 self.blobs.intern_bytes(h, data)
             if self.log is not None:
-                # durability first: log (with blob payloads) before apply
-                self.log.log_effect(
+                to_log.append((
                     shard, eff.key, eff.type_name, eff.bucket,
                     eff.eff_a, eff.eff_b, commit_vcs[i], origins[i],
                     eff.blob_refs,
-                )
+                ))
             by_table.setdefault(tname_t, []).append(
                 (shard, row, eff.eff_a, eff.eff_b, commit_vcs[i], origins[i])
             )
             touched.append((shard, np.asarray(commit_vcs[i], np.int32)))
+        if to_log:
+            # durability first: log (with blob payloads) before any device
+            # apply — and as ONE failure-atomic batch: a mid-group ENOSPC
+            # rolls the already-appended prefix back, so a commit group
+            # reported failed can never partially resurrect on recovery
+            self.log.log_effects(to_log)
         if inval:
             # one locked sweep per batch, not one acquisition per effect
             with self._value_cache_lock:

@@ -27,8 +27,11 @@ runtime in front of batched device kernels:
 
 from __future__ import annotations
 
+import errno
 import functools
 import itertools
+import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,9 +39,17 @@ import numpy as np
 
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt import TYPES, get_type, is_type
+from antidote_tpu.overload import (
+    BusyError,
+    DeadlineExceeded,
+    ReadOnlyError,
+    check_deadline,
+)
 from antidote_tpu.store.kv import BoundObject, Effect, KVStore
 from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
 from antidote_tpu.txn.hooks import HookRegistry
+
+log = logging.getLogger(__name__)
 
 @functools.lru_cache(maxsize=1)
 def _composite_names() -> frozenset:
@@ -116,6 +127,28 @@ class TransactionManager:
         import threading as _threading
 
         self.commit_lock = _threading.RLock()
+        # --- overload protection (PR 4): bounded commit backlog + the
+        # read-only degraded mode -------------------------------------
+        #: threads allowed to park on the commit lock before new commit
+        #: attempts are refused with a typed BusyError (the riak_core
+        #: vnode overload cap: a saturated vnode answers {error,
+        #: overload} instead of queueing unboundedly)
+        self.max_commit_backlog = 64
+        self._backlog_lock = _threading.Lock()
+        self._commit_backlog = 0
+        #: non-None while the node is in degraded READ-ONLY mode: the
+        #: WAL refused an append (ENOSPC / EIO).  Writes are rejected
+        #: with ReadOnlyError, reads keep serving, and the mode exits
+        #: automatically once an append probe succeeds again.
+        self.read_only_reason: Optional[str] = None
+        #: earliest monotonic time of the next recovery probe (the probe
+        #: fsyncs a sidecar file — rate-limit it under write storms)
+        self._ro_probe_at = 0.0
+        #: True while a multi-txn group is mid-publish: counters for the
+        #: whole group are already minted, so safe-time reads (heartbeat
+        #: pings) must wait for the group's last egress publish or they
+        #: outrun the stream (see _commit_group_locked)
+        self._publishing_group = False
         #: (key, bucket) -> my-lane counter of its last local commit.
         #: Bounded: entries at or below every open txn's snapshot can
         #: never conflict again and are GC'd periodically (the reference
@@ -436,7 +469,47 @@ class TransactionManager:
             raise out
         return out
 
-    def commit_transactions_group(self, txns: Sequence[Transaction]):
+    #: recovery probes while read-only are spaced at least this far apart
+    RO_PROBE_INTERVAL_S = 0.25
+
+    def check_writable(self) -> None:
+        """Raise :class:`ReadOnlyError` while the node is in degraded
+        read-only mode.  Each call past the probe interval re-probes the
+        WAL first, so the mode exits automatically (on the next write
+        attempt) once appends succeed again."""
+        if self.read_only_reason is None:
+            return
+        now = time.monotonic()
+        if now >= self._ro_probe_at and self.store.log is not None:
+            self._ro_probe_at = now + self.RO_PROBE_INTERVAL_S
+            try:
+                self.store.log.probe_append()
+            except OSError:
+                pass
+            else:
+                log.warning("WAL appends succeed again; leaving degraded "
+                            "read-only mode (was: %s)", self.read_only_reason)
+                self.read_only_reason = None
+                if self.metrics is not None:
+                    self.metrics.degraded_read_only.set(0)
+                return
+        if self.metrics is not None:
+            self.metrics.shed.inc(plane="read_only")
+        raise ReadOnlyError(self.read_only_reason)
+
+    def _enter_read_only(self, exc: OSError) -> None:
+        self.read_only_reason = (
+            f"WAL append failed ({errno.errorcode.get(exc.errno, exc.errno)}"
+            f"): {exc}"
+        )
+        self._ro_probe_at = time.monotonic() + self.RO_PROBE_INTERVAL_S
+        if self.metrics is not None:
+            self.metrics.degraded_read_only.set(1)
+        log.error("entering degraded READ-ONLY mode: %s",
+                  self.read_only_reason)
+
+    def commit_transactions_group(self, txns: Sequence[Transaction],
+                                  deadline: Optional[float] = None):
         """Commit several independent transactions as ONE device append —
         the group-commit seam the batched wire server drives (r4 VERDICT
         item 3).  Semantically identical to committing them sequentially:
@@ -450,13 +523,83 @@ class TransactionManager:
         /root/reference/src/clocksi_vnode.erl:588-632); the per-txn
         certify prop mirrors the reference's txn_props certify flag
         (/root/reference/src/clocksi_interactive_coord.erl
-        get_txn_property)."""
-        with self.commit_lock:
-            return self._commit_group_locked(txns)
+        get_txn_property).
+
+        Overload discipline (PR 4): admission is BOUNDED — at most
+        ``max_commit_backlog`` threads may park on the commit lock; past
+        the cap the group is refused with a typed :class:`BusyError`
+        instead of growing the convoy.  ``deadline`` (absolute monotonic)
+        is re-checked once the lock is held: work that outlived its
+        caller while queued is aborted at dequeue, not executed.  A
+        write-bearing group is refused with :class:`ReadOnlyError` while
+        the node is in degraded read-only mode (the check also runs the
+        auto-recovery probe)."""
+        has_writes = any(t.writeset for t in txns)
+        # backlog admission OUTSIDE the abort-cleanup scope: a backlog
+        # shed happens before the group's state is touched, so the txns
+        # stay OPEN and the caller may retry the same commit — the busy
+        # retry-after hint stays honest for interactive commits
+        with self._backlog_lock:
+            if self._commit_backlog >= self.max_commit_backlog:
+                if self.metrics is not None:
+                    self.metrics.shed.inc(plane="txn")
+                raise BusyError(
+                    f"commit backlog at max_commit_backlog="
+                    f"{self.max_commit_backlog}"
+                )
+            self._commit_backlog += 1
+        try:
+            try:
+                with self.commit_lock:
+                    try:
+                        check_deadline(deadline, "commit dequeue")
+                    except DeadlineExceeded:
+                        if self.metrics is not None:
+                            self.metrics.shed.inc(plane="deadline")
+                        raise
+                    if has_writes:
+                        self.check_writable()
+                    t0 = time.monotonic()
+                    try:
+                        return self._commit_group_locked(txns)
+                    except OSError as e:
+                        if has_writes and e.errno in (errno.ENOSPC,
+                                                      errno.EIO,
+                                                      errno.EROFS,
+                                                      errno.EDQUOT):
+                            # the WAL refused the append BEFORE any device
+                            # table mutated (durability-first ordering in
+                            # KVStore.apply_effects): fail the group and
+                            # flip into read-only degraded mode
+                            self._enter_read_only(e)
+                            raise ReadOnlyError(
+                                self.read_only_reason) from e
+                        raise
+                    finally:
+                        if self.metrics is not None and has_writes:
+                            self.metrics.commit_seconds.observe(
+                                time.monotonic() - t0)
+            finally:
+                with self._backlog_lock:
+                    self._commit_backlog -= 1
+        except BaseException:
+            # a shed/failed group must not leak open transactions: they
+            # pin the certification-GC floor forever (the same reason the
+            # server aborts orphans of dead connections).  Whatever
+            # _commit_group_locked already closed stays closed.
+            for t in txns:
+                if t.active:
+                    self._mark_aborted(t)
+            raise
 
     def _commit_group_locked(self, txns: Sequence[Transaction]):
         out: List[Any] = []
         pend: List[tuple] = []  # (txn, commit_vc, effects)
+        # rollback state for a failed apply (ENOSPC): certification
+        # stamps written for a group that is then NACKed would cause
+        # first-committer-aborts against phantom writes forever after
+        prev_counter = self.commit_counter
+        prev_stamps: Dict[tuple, Optional[int]] = {}
         for txn in txns:
             assert txn.active
             txn.active = False
@@ -502,7 +645,10 @@ class TransactionManager:
             # mark BEFORE later group members certify: a group peer whose
             # snapshot predates this commit must first-committer-abort
             for eff, _ in txn.writeset:
-                self.committed_keys[(eff.key, eff.bucket)] = self.commit_counter
+                ck = (eff.key, eff.bucket)
+                if ck not in prev_stamps:
+                    prev_stamps[ck] = self.committed_keys.get(ck)
+                self.committed_keys[ck] = self.commit_counter
             pend.append((txn, commit_vc, effects))
             out.append(commit_vc)
         if pend:
@@ -511,16 +657,42 @@ class TransactionManager:
             for _, vc, effs in pend:
                 all_effs.extend(effs)
                 all_vcs.extend([vc] * len(effs))
-            self.store.apply_effects(
-                all_effs, all_vcs, [self.my_dc] * len(all_effs)
-            )
-            for txn, commit_vc, effects in pend:
-                for listener in self.commit_listeners:
-                    listener(effects, commit_vc, self.my_dc)
-                for eff, op in txn.writeset:
-                    self.hooks.execute_post_commit_hook(
-                        eff.key, eff.type_name, eff.bucket, op
-                    )
+            try:
+                self.store.apply_effects(
+                    all_effs, all_vcs, [self.my_dc] * len(all_effs)
+                )
+            except BaseException:
+                # nothing durable or device-visible happened (the WAL
+                # batch rolled itself back): un-stamp the certification
+                # marks and counters too, or later txns would first-
+                # committer-abort against writes that never existed
+                self.commit_counter = prev_counter
+                for ck, old in prev_stamps.items():
+                    if old is None:
+                        self.committed_keys.pop(ck, None)
+                    else:
+                        self.committed_keys[ck] = old
+                raise
+            # the group minted EVERY member's commit counter above, but
+            # members publish one at a time below — so a safe-time read
+            # from inside an early member's egress listener (the
+            # commit-path heartbeat threshold) would return a counter
+            # covering still-unpublished members.  A subscriber that
+            # trusts such a ping advances its chain clock past them and
+            # then drops their real messages as duplicates: permanently
+            # lost effects.  The flag makes listeners defer heartbeats
+            # until the whole group is on the stream.
+            self._publishing_group = len(pend) > 1
+            try:
+                for txn, commit_vc, effects in pend:
+                    for listener in self.commit_listeners:
+                        listener(effects, commit_vc, self.my_dc)
+                    for eff, op in txn.writeset:
+                        self.hooks.execute_post_commit_hook(
+                            eff.key, eff.type_name, eff.bucket, op
+                        )
+            finally:
+                self._publishing_group = False
         if self.commit_counter >= self._next_cert_gc:
             self._gc_committed_keys()
             self._next_cert_gc = self.commit_counter + self._cert_gc_every
@@ -573,10 +745,15 @@ class TransactionManager:
         txn = self.start_transaction(clock)
         try:
             self.update_objects(updates, txn)
+            return self.commit_transaction(txn)
         except Exception:
-            self.abort_transaction(txn)
+            # the static caller owns this txn and can never retry its
+            # txid — a commit shed (backlog BusyError leaves the txn
+            # OPEN for interactive retries) must not leak it into the
+            # certification-GC floor
+            if txn.active:
+                self.abort_transaction(txn)
             raise
-        return self.commit_transaction(txn)
 
     def read_objects_static(
         self, objects: Sequence[BoundObject], clock: Optional[np.ndarray] = None
@@ -584,10 +761,11 @@ class TransactionManager:
         txn = self.start_transaction(clock)
         try:
             vals = self.read_objects(objects, txn)
+            self.commit_transaction(txn)  # empty writeset: closes the txn
         except Exception:
-            self.abort_transaction(txn)
+            if txn.active:
+                self.abort_transaction(txn)
             raise
-        self.commit_transaction(txn)  # empty writeset: closes the txn
         return vals, txn.snapshot_vc
 
     # ------------------------------------------------------------------

@@ -24,8 +24,15 @@ Configs mirror BASELINE.json:
   2 register    lww + mv assign/read, uniform
   3 set_aw      Zipfian add/remove + reads (the north-star workload)
   4 map_rr      nested map update/read
-  5 rga         covered by bench_suite.py (3-DC in-process topology —
-                the wire protocol is single-node)
+  5 rga         sequence head-inserts + snapshot reads, 1:1 (r5 VERDICT
+                weak #7: finally measured over the wire; the 3-DC causal
+                merge variant stays in bench_suite.py)
+
+`--saturation` runs the PR 4 write-plane sweep instead: write-only
+offered load stepped well past the admission knee, recording goodput
+(acked ops/s), typed-shed counts, and latency per step — the artifact
+proof that saturation degrades into controlled shedding (goodput flat
+past the knee) rather than latency collapse.
 
 BEAM stand-in note: the reference publishes no numbers and the BEAM
 cannot run in this image, so `vs_baseline` in the companion suites
@@ -96,7 +103,7 @@ def _env():
     return env
 
 
-def _spawn_server(shards: int, keys_hint: int = 0):
+def _spawn_server(shards: int, keys_hint: int = 0, extra=()):
     cmd = [sys.executable, "-m", "antidote_tpu.console", "serve",
            "--port", "0", "--shards", str(shards), "--max-dcs", "2"]
     if keys_hint:
@@ -104,6 +111,7 @@ def _spawn_server(shards: int, keys_hint: int = 0):
         # reallocate the device tables and recompile every serving shape
         cmd += ["--keys-per-table",
                 str(max(1024, (keys_hint + shards - 1) // shards))]
+    cmd += list(extra)
     p = subprocess.Popen(
         cmd, env=_env(), stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
     )
@@ -185,6 +193,17 @@ def _op_map_rr(c, rng, k, is_read):
         ]))])
 
 
+def _op_rga(c, rng, k, is_read):
+    # head inserts are always position-valid regardless of interleaving
+    # with other workers, so every op is well-formed over the wire; the
+    # keyspace keeps per-doc length far below the slot ring
+    if is_read:
+        c.read_objects([(f"doc{k}", "rga", "b")])
+    else:
+        c.update_objects([(f"doc{k}", "rga", "b",
+                           ("insert", (0, f"c{int(rng.integers(100))}")))])
+
+
 CONFIGS = {
     1: {"name": "counter_pn_10k_9r1w", "op": "counter",
         "keys": (1000, 10_000), "zipf": False},
@@ -194,10 +213,12 @@ CONFIGS = {
         "keys": (20_000, 200_000), "zipf": True},
     4: {"name": "map_rr_nested", "op": "map_rr",
         "keys": (500, 2_000), "zipf": False},
+    5: {"name": "rga_seq_head_insert", "op": "rga",
+        "keys": (500, 2_000), "zipf": False, "read_frac": 0.5},
 }
 
 OP_FNS = {"counter": _op_counter, "register": _op_register,
-          "set_aw": _op_set_aw, "map_rr": _op_map_rr}
+          "set_aw": _op_set_aw, "map_rr": _op_map_rr, "rga": _op_rga}
 
 
 def _make_op(opname: str, n_keys: int, zipf: bool, read_frac: float):
@@ -249,6 +270,8 @@ def _run_threads(host, port, op, n_workers, duration_s, seed0):
 
 
 def _worker_child(args) -> int:
+    if args.mode == "saturate":
+        return _saturate_child(args)
     cfg = CONFIGS[args.config]
     op = _make_op(cfg["op"], args.keys, cfg["zipf"], args.read_frac)
     ops, lat_ms = _run_threads(args.host, args.port, op,
@@ -259,6 +282,236 @@ def _worker_child(args) -> int:
         lat_ms = list(np.asarray(lat_ms)[idx])
     print(json.dumps({"ops": ops, "lat_ms": lat_ms}))
     return 0
+
+
+def _saturate_child(args) -> int:
+    """Write-only RATE-PACED saturation worker: a FIXED thread pool
+    offers ``--rate`` counter increments per second (spread over the
+    workers), counting acked ops (goodput) separately from typed sheds.
+    Pacing — not thread count — carries the offered load, so the
+    driver's own CPU footprint stays constant across sweep steps and
+    the goodput curve measures the SERVER, not driver contention.  A
+    worker behind schedule skips missed slots instead of building a
+    backlog (open-loop semantics past the knee).  A shed worker HONORS
+    the server's retry-after hint before its next attempt: the hint is
+    the client half of the overload protocol — without it every shed is
+    instantly re-offered and the server drowns its cores in shed
+    handling (exactly the collapse the protocol exists to prevent).
+    Slots skipped while backing off are still counted as sheds, so the
+    pressure stays visible in the artifact."""
+    from antidote_tpu.proto.client import (AntidoteClient, RemoteBusy,
+                                           RemoteDeadline)
+
+    stop = time.perf_counter() + args.duration
+    n = args.workers
+    interval = n / args.rate if args.rate > 0 else 0.0
+    acked = [0] * n
+    busy = [0] * n
+    deadline = [0] * n
+    lats = [[] for _ in range(n)]
+    errs = []
+
+    def worker(i):
+        rng = np.random.default_rng(args.seed + i)
+        try:
+            c = AntidoteClient(args.host, args.port)
+            next_t = time.perf_counter() + interval * (i / max(1, n))
+            while True:
+                now = time.perf_counter()
+                if now >= stop:
+                    break
+                if interval and now < next_t:
+                    time.sleep(min(next_t - now, 0.01))
+                    continue
+                # skip slots missed while blocked (no offered-load debt)
+                next_t = max(next_t + interval, now)
+                k = int(rng.integers(args.keys))
+                t0 = time.perf_counter()
+                try:
+                    c.update_objects(
+                        [(k, "counter_pn", "b", ("increment", 1))],
+                        deadline_ms=args.deadline_ms or None)
+                except RemoteBusy as e:
+                    busy[i] += 1
+                    back = min(e.retry_after_ms, 100) / 1e3
+                    if interval:
+                        # well-behaved backoff: count the paced slots
+                        # the hint tells us to skip as sheds too (the
+                        # offered load doesn't drop just because the
+                        # client is polite about resubmitting it)
+                        busy[i] += int(back / interval)
+                        next_t += back
+                    time.sleep(back)
+                    continue
+                except RemoteDeadline:
+                    deadline[i] += 1
+                    continue
+                lats[i].append((time.perf_counter() - t0) * 1e3)
+                acked[i] += 1
+            c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=args.duration + 60)
+    lat = [x for l in lats for x in l]
+    if len(lat) > 20_000:
+        idx = np.linspace(0, len(lat) - 1, 20_000).astype(int)
+        lat = list(np.asarray(lat)[idx])
+    print(json.dumps({"ops": sum(acked), "busy": sum(busy),
+                      "deadline": sum(deadline), "lat_ms": lat,
+                      "errs": errs}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# write-plane saturation sweep (PR 4 acceptance: goodput within 20% of
+# peak at 2x the knee, shed counts reported)
+# ---------------------------------------------------------------------------
+SAT_STEP_S = 5
+SAT_KEYS = 1024
+#: fixed worker pool (per the whole sweep): pacing, not thread count,
+#: carries the offered load, so driver CPU cost stays ~constant
+SAT_WORKERS = 16
+#: admission cap — deliberately BELOW the worker pool, so offered load
+#: past capacity lands in typed busy sheds (the behaviour under test:
+#: goodput stays flat past the knee, sheds absorb the excess)
+SAT_MAX_IN_FLIGHT = 8
+#: offered-load steps as multiples of the MEASURED closed-loop append
+#: capacity — absolute rates are meaningless across hosts, and the
+#: group-commit batcher makes efficiency load-dependent, so the sweep
+#: calibrates itself: the knee lands at ~1.0x by construction and the
+#: artifact records behaviour at 2x and 4x beyond it
+SAT_STEP_FRACS = (0.25, 0.5, 1.0, 2.0, 4.0)
+
+
+def bench_saturation(smoke: bool, assert_bounds: bool = False):
+    global HOST, PORT
+    fracs = (0.5, 1.0, 2.0, 4.0) if smoke else SAT_STEP_FRACS
+    workers = 8 if smoke else SAT_WORKERS
+    max_in_flight = 4 if smoke else SAT_MAX_IN_FLIGHT
+    step_s = 3 if smoke else SAT_STEP_S
+    procs, info = _spawn_server(
+        16, keys_hint=SAT_KEYS,
+        # NO per-client cap override: the whole driver is one peer host,
+        # so any per-client cap below the global one would become the
+        # operative bound and the sweep would measure it instead
+        extra=["--max-in-flight", str(max_in_flight)])
+    HOST, PORT = info["host"], info["port"]
+    n_procs = 2
+    steps = []
+    try:
+        # untimed warm rounds compile the update shape family; the best
+        # unpaced run IS the measured closed-loop capacity that
+        # calibrates the offered-load steps.  Calibration runs with
+        # exactly max_in_flight workers: more would hot-spin on busy
+        # replies and bill shed handling against the capacity number
+        rounds = []
+        for _ in range(3 if smoke else 4):
+            ops, _b, _d, _l = _run_sat_step(max_in_flight, n_procs,
+                                            step_s, SAT_KEYS, rate=0)
+            rounds.append(ops / step_s)
+        # median of the post-compile rounds: the first pays XLA compile,
+        # a max would let one lucky round overdrive every paced step
+        closed_loop = round(float(np.median(rounds[1:])), 1)
+        # one untimed pass at the sweep's TOP rate: overload bursts form
+        # larger commit groups than the calibration concurrency, and the
+        # first visit to a bigger batch bucket compiles a new XLA shape —
+        # a multi-second stall that must not be billed to a measured step
+        _run_sat_step(workers, n_procs, step_s, SAT_KEYS,
+                      rate=closed_loop * max(fracs))
+        for f in fracs:
+            rate = max(20.0, closed_loop * f)
+            ops, busy, dl, lat = _run_sat_step(workers, n_procs, step_s,
+                                               SAT_KEYS, rate=rate)
+            steps.append({
+                "offered_x_capacity": f,
+                "offered_ops_s": round(rate, 1),
+                "goodput_ops_s": round(ops / step_s, 1),
+                "shed_busy": busy, "shed_deadline": dl,
+                **(_percentiles(lat) if lat else {}),
+            })
+            print(json.dumps(steps[-1]), flush=True)
+        peak = max(s["goodput_ops_s"] for s in steps)
+        # the knee IS the measured-capacity step (1.0x): the steps are
+        # calibrated to it, so "2x the knee" always exists and the
+        # definition is immune to step-to-step noise
+        knee = next(s for s in steps if s["offered_x_capacity"] == 1.0)
+        past = [s for s in steps if s["offered_x_capacity"] >= 2.0]
+        frac = (min(s["goodput_ops_s"] for s in past) / peak) if past \
+            else None
+        out = {
+            "workload": "counter_pn write-only (append capacity)",
+            "workers": workers, "driver_procs": n_procs,
+            "step_s": step_s,
+            "max_in_flight": max_in_flight,
+            "closed_loop_ops_s": closed_loop,
+            "steps": steps,
+            "append_capacity_ops_s": peak,
+            "knee_offered_ops_s": knee["offered_ops_s"],
+            "goodput_at_2x_knee_frac":
+                None if frac is None else round(frac, 3),
+            "shed_total": sum(s["shed_busy"] + s["shed_deadline"]
+                              for s in steps),
+            "smoke": bool(smoke),
+        }
+        print(json.dumps(out), flush=True)
+        if assert_bounds:
+            # the PR 4 bound: overload degrades into controlled typed
+            # shedding, never a wedge or a cliff.  The FULL run holds
+            # the 20%-of-peak artifact bound; the smoke gate asserts
+            # only the structural properties — on this class of host
+            # the driver and server share cores, so short-step
+            # throughput ratios are noise-bound (the seeded chaos
+            # scenario `make saturation` also runs carries the exact
+            # correctness assertions).
+            assert frac is not None, "sweep never reached 2x the knee"
+            if not smoke:
+                assert frac >= 0.8, (
+                    f"goodput collapsed past the knee: {frac:.2f} of peak")
+            assert out["shed_total"] > 0, (
+                "the sweep never pushed the server into shedding")
+            top = steps[-1]
+            assert top.get("p99_ms", 0) < 2000, (
+                "server latency wedged past the knee: "
+                f"p99={top.get('p99_ms')}ms")
+        return out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _run_sat_step(workers, n_procs, step_s, n_keys, rate):
+    per = max(1, workers // n_procs)
+    procs = []
+    for p in range(n_procs):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker-child",
+             "--mode", "saturate", "--keys", str(n_keys), "--host", HOST,
+             "--port", str(PORT), "--workers", str(per),
+             "--rate", str(rate / n_procs),
+             "--duration", str(step_s), "--seed", str(5000 + 100 * p)],
+            env=_env(), stdout=subprocess.PIPE,
+        ))
+    ops = busy = dl = 0
+    lat = []
+    for p in procs:
+        out, _ = p.communicate(timeout=step_s + 120)
+        d = json.loads(out.decode().strip().splitlines()[-1])
+        assert not d.get("errs"), d["errs"]
+        ops += d["ops"]
+        busy += d["busy"]
+        dl += d["deadline"]
+        lat.extend(d["lat_ms"])
+    return ops, busy, dl, lat
 
 
 def _run_workers_mp(cfg_id, n_keys, read_frac, workers, duration_s,
@@ -296,6 +549,7 @@ def bench_config(cfg_id, smoke, workers=32, read_frac=0.9, spawn=None,
                  tag=""):
     global HOST, PORT
     cfg = CONFIGS[cfg_id]
+    read_frac = cfg.get("read_frac", read_frac)
     n_keys = cfg["keys"][0] if smoke else cfg["keys"][1]
     if spawn is None:
         procs, info = _spawn_server(16, keys_hint=n_keys)
@@ -360,10 +614,23 @@ def main():
     ap.add_argument("--workers", type=int, default=32)
     ap.add_argument("--cluster", action="store_true",
                     help="drive a 2-member DC instead of a single node")
+    ap.add_argument("--saturation", action="store_true",
+                    help="run the write-plane saturation sweep instead "
+                         "of the throughput configs")
+    ap.add_argument("--assert-bounds", action="store_true",
+                    help="with --saturation: fail unless goodput stays "
+                         "within 20%% of peak past the knee (the `make "
+                         "saturation` CI gate)")
     # worker-child mode (internal)
     ap.add_argument("--worker-child", action="store_true")
+    ap.add_argument("--mode", default="mixed",
+                    help="worker-child op mode: mixed | saturate")
     ap.add_argument("--keys", type=int, default=0)
     ap.add_argument("--read-frac", type=float, default=0.9)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="saturate mode: offered ops/s for this child "
+                         "(0 = unpaced closed loop)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--duration", type=float, default=10.0)
@@ -372,19 +639,41 @@ def main():
     if args.worker_child:
         sys.exit(_worker_child(args))
     smoke = args.smoke
+    if args.saturation:
+        out = bench_saturation(smoke, assert_bounds=args.assert_bounds)
+        if args.json:
+            _write_artifact(args.json, saturation=out)
+        return 0
     spawn = _spawn_cluster if args.cluster else None
     tag = "_cluster" if args.cluster else ""
 
     results = []
-    ids = [args.config] if args.config else [1, 2, 3, 4]
+    ids = [args.config] if args.config else [1, 2, 3, 4, 5]
     for cid in ids:
         results.append(bench_config(cid, smoke, workers=args.workers,
                                     spawn=spawn, tag=tag))
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump({"driver_rev": DRIVER_REV, "results": results},
-                      f, indent=2)
+        _write_artifact(args.json, results=results)
     return 0
+
+
+def _write_artifact(path, results=None, saturation=None):
+    """Merge this run into the artifact instead of clobbering it: a
+    single-config or --saturation run must not erase the other frozen
+    sections (results merge by config name; saturation replaces whole)."""
+    doc = {"driver_rev": DRIVER_REV}
+    if os.path.exists(path):
+        with open(path) as f:
+            doc.update(json.load(f))
+        doc["driver_rev"] = DRIVER_REV
+    if results is not None:
+        merged = {r["config"]: r for r in doc.get("results", [])}
+        merged.update({r["config"]: r for r in results})
+        doc["results"] = list(merged.values())
+    if saturation is not None:
+        doc["saturation"] = saturation
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
 
 
 if __name__ == "__main__":

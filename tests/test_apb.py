@@ -449,3 +449,45 @@ def test_apb_bounded_counter_ops_carry_actor_lane():
         c.close()
     finally:
         srv.close()
+
+
+def test_apb_commit_busy_keeps_descriptor_retryable():
+    """A commit-backlog shed leaves the txn OPEN for retry in the native
+    dialect; the apb dialect must match — popping the descriptor before
+    the outcome is known would turn the advertised busy-retry into
+    KeyError('unknown transaction') and leak an unreachable open txn
+    pinning the certification-GC floor."""
+    node, srv = _mk_server()
+    try:
+        c = _ApbConn("127.0.0.1", srv.port)
+        name, resp = c.call("ApbStartTransaction", {})
+        txd = resp["transaction_descriptor"]
+        c.call("ApbUpdateObjects", {
+            "transaction_descriptor": txd,
+            "updates": [{"boundobject": {"key": b"bz", "type": 3,
+                                         "bucket": b"b"},
+                         "operation": {"counterop": {"inc": 5}}}],
+        })
+        saved = node.txm.max_commit_backlog
+        node.txm.max_commit_backlog = 0  # every commit sheds busy
+        try:
+            name, resp = c.call("ApbCommitTransaction",
+                                {"transaction_descriptor": txd})
+            assert name == "ApbErrorResp"
+            assert resp["errmsg"].startswith(b"busy retry_after_ms="), resp
+            assert node.txm._open_snaps, "busy shed must leave the txn open"
+        finally:
+            node.txm.max_commit_backlog = saved
+        # pressure gone: the SAME descriptor commits
+        name, resp = c.call("ApbCommitTransaction",
+                            {"transaction_descriptor": txd})
+        assert name == "ApbCommitResp" and resp["success"], resp
+        name, resp = c.call("ApbStaticReadObjects", {
+            "transaction": {"timestamp": resp["commit_time"]},
+            "objects": [{"key": b"bz", "type": 3, "bucket": b"b"}],
+        })
+        assert resp["objects"]["objects"][0]["counter"]["value"] == 5
+        assert not node.txm._open_snaps and not srv._txns
+        c.close()
+    finally:
+        srv.close()

@@ -13,7 +13,13 @@ Checks (each one has caught a real bug class in this codebase's history):
   * broad except-and-continue inside ``while`` loops (a thread loop
     that swallows every exception and spins on is a silently-dead
     subsystem — the failure class the supervised ThreadLoop exists to
-    prevent; surface the error or supervise the loop instead).
+    prevent; surface the error or supervise the loop instead);
+  * unbounded queue construction in the overload-protected planes
+    (``proto/``, ``interdc/``, ``txn/``): ``queue.Queue()`` without a
+    maxsize, ``collections.deque()`` without a maxlen, and
+    queue-factory ``defaultdict``s must either carry an explicit bound
+    or a ``# bounded-by: <reason>`` annotation within the three lines
+    above — saturation must shed, never buffer without limit (PR 4).
 
 Usage: python tools/lint.py [paths...]   (default: antidote_tpu tests
 bench.py bench_suite.py bench_wire.py tpu_smoke.py __graft_entry__.py)
@@ -105,7 +111,65 @@ def check_file(path: str):
             if node.type is None and not noqa(node.lineno):
                 problems.append(f"{path}:{node.lineno}: bare 'except:'")
     _check_swallow_loops(tree, path, noqa, problems)
+    _check_unbounded_queues(tree, path, lines, problems)
     return problems
+
+
+#: planes under overload protection: every queue here is bounded or
+#: carries a written justification (ISSUE 4 tentpole discipline)
+_BOUNDED_PLANES = (
+    os.path.join("antidote_tpu", "proto"),
+    os.path.join("antidote_tpu", "interdc"),
+    os.path.join("antidote_tpu", "txn"),
+)
+
+
+def _check_unbounded_queues(tree, path, lines, problems) -> None:
+    """In proto/, interdc/, txn/: flag queue constructions with no bound
+    (queue.Queue()/LifoQueue() without maxsize, SimpleQueue(),
+    collections.deque() without maxlen, defaultdict(list|deque)
+    buffer registries) unless a ``# bounded-by:`` annotation within the
+    three preceding lines (or the construction line) states the bound."""
+    norm = os.path.normpath(path)
+    if not any(plane in norm for plane in _BOUNDED_PLANES):
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("bounded-by:" in ln for ln in lines[lo:lineno])
+
+    def call_name(fn) -> str:
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return ""
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node.func)
+        bad = None
+        if name in ("Queue", "LifoQueue"):
+            if not node.args and not any(k.arg == "maxsize"
+                                         for k in node.keywords):
+                bad = f"{name}() without maxsize"
+        elif name == "SimpleQueue":
+            bad = "SimpleQueue() (never bounded)"
+        elif name == "deque":
+            if len(node.args) < 2 and not any(k.arg == "maxlen"
+                                              for k in node.keywords):
+                bad = "deque() without maxlen"
+        elif name == "defaultdict" and node.args:
+            factory = call_name(node.args[0])
+            if factory in ("list", "deque"):
+                bad = f"defaultdict({factory}) buffer registry"
+        if bad and not annotated(node.lineno):
+            problems.append(
+                f"{path}:{node.lineno}: unbounded queue in an "
+                f"overload-protected plane: {bad} — give it an explicit "
+                "bound or justify with '# bounded-by: <reason>' above"
+            )
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
