@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -28,6 +28,13 @@ saturation:
 	$(PY) -m pytest tests/test_overload.py \
 	  "tests/test_chaos.py::test_saturation_storm_enospc_bounded_and_converges" -q
 	$(PY) bench_wire.py --saturation --smoke --assert-bounds
+
+# serving-pipeline smoke (ISSUE 5): ~30s read-only north-star wire run;
+# fails when read throughput drops below 0.8x the frozen perf_smoke
+# entry in BENCH_WIRE_cpu.json — the CI tripwire for the lock-split
+# epoch-read plane (runs alongside `make saturation` in CI)
+perf-smoke:
+	$(PY) bench_wire.py --perf-smoke --assert-bounds --json BENCH_WIRE_cpu.json
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

@@ -104,6 +104,8 @@ class AntidoteNode:
         #: prometheus-parity metric set (antidote_stats_collector, SURVEY §2.7)
         self.metrics = NodeMetrics()
         self.txm.metrics = self.metrics
+        # snapshot-cache / serving-epoch counters land in the same registry
+        self.store.metrics = self.metrics
         # count this package's ERROR-level log records (antidote_error_monitor)
         self._error_handler = install_error_monitor(
             self.metrics, logging.getLogger("antidote_tpu")

@@ -133,6 +133,8 @@ def cmd_serve(args) -> int:
             max_in_flight=args.max_in_flight,
             max_in_flight_per_client=args.max_in_flight_per_client,
             default_deadline_ms=args.default_deadline_ms,
+            epoch_tick_ms=args.epoch_tick_ms,
+            snapshot_cache_size=args.snapshot_cache_size,
         )
         return server_box["srv"]
 
@@ -353,6 +355,13 @@ def main(argv=None) -> int:
                     help="server-side deadline for requests that carry no "
                          "deadline_ms field; work that outlives it is "
                          "aborted at dequeue (default: no deadline)")
+    sv.add_argument("--epoch-tick-ms", type=float, default=100.0,
+                    help="serving-epoch publication cadence for the "
+                         "dedicated ticker (<= 0 disables the lock-split "
+                         "epoch read plane entirely)")
+    sv.add_argument("--snapshot-cache-size", type=int, default=None,
+                    help="hot-key snapshot cache capacity in entries "
+                         "(default: the store's built-in 65536)")
     sv.set_defaults(fn=cmd_serve)
 
     for name, fn in (("status", cmd_status), ("ready", cmd_ready)):

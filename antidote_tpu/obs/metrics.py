@@ -122,6 +122,18 @@ class Histogram:
     def count(self) -> int:
         return self._n
 
+    def summary(self) -> Dict[str, float]:
+        """Compact (count, mean, p50, p99) view for node-status blocks —
+        quantiles are bucket upper bounds, same as :meth:`percentile`."""
+        with self._lock:
+            n, s = self._n, self._sum
+        return {
+            "count": n,
+            "mean": (s / n) if n else 0.0,
+            "p50": self.percentile(0.5),
+            "p99": self.percentile(0.99),
+        }
+
     def percentile(self, q: float) -> float:
         """Approximate q-quantile from bucket counts (upper bound)."""
         if self._n == 0:
@@ -350,6 +362,61 @@ class NodeMetrics:
             "antidote_commit_seconds",
             "Commit-group latency inside the commit lock (s)",
             buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+        )
+        # serving pipeline (ISSUE 5): per-stage wire-server timings plus
+        # the serving-epoch / hot-key snapshot-cache planes.  Stage
+        # histograms use µs-resolution buckets — the whole point of the
+        # staged pipeline is that each stage is far below a millisecond.
+        stage_buckets = (2e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                         5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.5, 1)
+        self.stage_decode_seconds = r.histogram(
+            "antidote_stage_decode_seconds",
+            "Pipeline stage: frame decode + admit, per request (s)",
+            buckets=stage_buckets,
+        )
+        self.stage_parked_seconds = r.histogram(
+            "antidote_stage_parked_seconds",
+            "Pipeline stage: time parked in a bounded queue before its "
+            "stage dequeued it, per request (s)",
+            buckets=stage_buckets,
+        )
+        self.stage_launch_seconds = r.histogram(
+            "antidote_stage_launch_seconds",
+            "Pipeline stage: epoch-read classify + device launch, per "
+            "batch — async dispatch only, never a device sync (s)",
+            buckets=stage_buckets,
+        )
+        self.stage_writeback_seconds = r.histogram(
+            "antidote_stage_writeback_seconds",
+            "Pipeline stage: device materialize + decode + reply "
+            "serialization, per batch (s)",
+            buckets=stage_buckets,
+        )
+        self.snapshot_cache = r.counter(
+            "antidote_snapshot_cache_total",
+            "Hot-key snapshot cache events (hit | miss | evict)",
+            ("event",),
+        )
+        self.serving_reads = r.counter(
+            "antidote_serving_reads_total",
+            "Static reads by serving path (cache | gather | locked)",
+            ("path",),
+        )
+        self.epoch_publish = r.counter(
+            "antidote_epoch_publish_total",
+            "Serving-epoch publications by mode (scatter | copy | defer)",
+            ("mode",),
+        )
+        self.epoch_rows = r.counter(
+            "antidote_epoch_rows_total",
+            "Rows re-frozen by serving-epoch publications, by mode — "
+            "scatter rows scale with the write working set, copy rows "
+            "with table size (the publish-cost cap's observable)",
+            ("mode",),
+        )
+        self.serving_epoch_id = r.gauge(
+            "antidote_serving_epoch_id",
+            "Monotone id of the last published serving epoch",
         )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)

@@ -11,12 +11,14 @@ import socket
 import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
+import msgpack
+
 from antidote_tpu.proto.codec import (
     MessageCode,
     decode,
     decode_value,
-    read_frame,
-    write_message,
+    encode_with,
+    read_frame_buffered,
 )
 
 
@@ -78,12 +80,18 @@ class AntidoteClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        # hot-path plumbing: a buffered reader coalesces the header+body
+        # reads into ~one syscall per reply, and one persistent Packer
+        # skips per-call packer construction — this client is the load
+        # generator in bench_wire, where its CPU bills against the server
+        self._rfile = self._sock.makefile("rb")
+        self._packer = msgpack.Packer(use_bin_type=True)
 
     # ------------------------------------------------------------------
     def _call(self, code: MessageCode, body: Any):
         with self._lock:
-            write_message(self._sock, code, body)
-            resp_code, resp = decode(read_frame(self._sock))
+            self._sock.sendall(encode_with(self._packer, code, body))
+            resp_code, resp = decode(read_frame_buffered(self._rfile))
         if resp_code == MessageCode.ERROR_RESP:
             err = resp.get("error")
             if err == "aborted":
@@ -156,4 +164,8 @@ class AntidoteClient:
                           {"include_ready": include_ready})["status"]
 
     def close(self) -> None:
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
         self._sock.close()

@@ -74,6 +74,15 @@ def encode(code: MessageCode, body: Any) -> bytes:
     return struct.pack(">IB", len(payload) + 1, int(code)) + payload
 
 
+def encode_with(packer: "msgpack.Packer", code: MessageCode,
+                body: Any) -> bytes:
+    """Framed encode through a caller-owned persistent Packer (hot-path
+    clients skip per-call packer construction) — same frame layout as
+    :func:`encode`, owned here so the wire contract lives in one file."""
+    payload = packer.pack(body)
+    return struct.pack(">IB", len(payload) + 1, int(code)) + payload
+
+
 def decode(frame: bytes) -> Tuple[MessageCode, Any]:
     code = MessageCode(frame[0])
     body = msgpack.unpackb(frame[1:], raw=False, strict_map_key=False)
@@ -87,6 +96,22 @@ def read_frame(sock: socket.socket) -> bytes:
     if not 1 <= n <= MAX_FRAME:
         raise ConnectionError(f"bad frame length {n}")
     return _read_exact(sock, n)
+
+
+def read_frame_buffered(rfile) -> bytes:
+    """Read one frame off a buffered binary file (``sock.makefile('rb')``)
+    — the serving hot path's framing: the buffer coalesces the header +
+    body reads into ~one syscall per request instead of 2+ recv calls."""
+    hdr = rfile.read(4)
+    if len(hdr) < 4:
+        raise ConnectionError("peer closed")
+    (n,) = struct.unpack(">I", hdr)
+    if not 1 <= n <= MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    body = rfile.read(n)
+    if len(body) < n:
+        raise ConnectionError("peer closed")
+    return body
 
 
 def write_message(sock: socket.socket, code: MessageCode, body: Any) -> None:

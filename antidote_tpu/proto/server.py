@@ -38,9 +38,10 @@ from antidote_tpu.proto import apb
 from antidote_tpu.proto.codec import (
     MessageCode,
     decode,
+    encode,
     encode_value,
     freeze,
-    read_frame,
+    read_frame_buffered,
     write_frame_body,
     write_message,
 )
@@ -56,10 +57,11 @@ class _StaticWork:
     """One client's static read/update parked at the batch gate."""
 
     __slots__ = ("kind", "objects", "updates", "clock", "event", "result",
-                 "error", "deadline")
+                 "error", "deadline", "t_submit", "wants_bytes",
+                 "reply_bytes")
 
     def __init__(self, kind, objects=None, updates=None, clock=None,
-                 deadline=None):
+                 deadline=None, wants_bytes=False):
         self.kind = kind
         self.objects = objects
         self.updates = updates
@@ -71,6 +73,37 @@ class _StaticWork:
         #: batch dispatcher DEQUEUES the work — a request that outlived
         #: its caller while parked is aborted, not executed
         self.deadline: Optional[float] = deadline
+        #: submit timestamp (stage_parked histogram)
+        self.t_submit = 0.0
+        #: native-dialect reads ask the writeback stage to serialize the
+        #: reply frame for them (batched reply serialization: one tight
+        #: encode loop instead of per-connection wakeup-then-frame)
+        self.wants_bytes = wants_bytes
+        self.reply_bytes: Optional[bytes] = None
+
+
+class RawReply:
+    """A fully-framed response produced by the writeback stage — the
+    handler sends the bytes as-is."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+
+
+class _EpochReadBatch:
+    """A launched (but unmaterialized) merged epoch-read batch in flight
+    between the dispatcher's launch stage and the writeback stage: device
+    handles plus the per-work result spans."""
+
+    __slots__ = ("pending", "works", "spans", "vc_list")
+
+    def __init__(self, pending, works, spans, vc_list):
+        self.pending = pending
+        self.works = works
+        self.spans = spans
+        self.vc_list = vc_list
 
 
 def _decode_objects(objs):
@@ -83,6 +116,7 @@ def _decode_updates(ups):
 
 
 def _vc(x) -> Optional[np.ndarray]:
+    # sync-ok: converts a wire-decoded int list, never a jax array
     return None if x is None else np.asarray(x, np.int32)
 
 
@@ -91,7 +125,9 @@ class ProtocolServer:
                  port: int = 0, interdc=None, max_connections: int = 1024,
                  batch_static: bool = True, max_in_flight: int = 256,
                  max_in_flight_per_client: int = 64, queue_max: int = 4096,
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 epoch_tick_ms: float = 100.0,
+                 snapshot_cache_size: Optional[int] = None):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
@@ -137,12 +173,65 @@ class ProtocolServer:
         #: against a stalled dispatcher)
         self._static_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         self._batch_max = 1024
+        #: per-handler-thread scratch (stage_decode timing)
+        self._tls = threading.local()
+        # --- staged serving pipeline (ISSUE 5) -------------------------
+        #: serving-epoch publication cadence for the dedicated ticker
+        self.epoch_tick_ms = epoch_tick_ms
+        txm = getattr(node, "txm", None)
+        #: lock-split epoch reads need the single-node txn manager (the
+        #: cluster facade routes through 2PC) and the batch dispatcher;
+        #: epoch_tick_ms <= 0 disables the whole epoch plane (operator
+        #: escape hatch back to the locked serving path)
+        self._epoch_reads = bool(batch_static and txm is not None
+                                 and epoch_tick_ms > 0)
+        if self._epoch_reads:
+            txm.enable_serving_epochs()
+            self._epoch_reads = txm.serving_epochs  # clocksi-only
+            if snapshot_cache_size is not None:
+                txm.store.snapshot_cache_cap = int(snapshot_cache_size)
+            if txm.store.metrics is None:
+                txm.store.metrics = self.metrics
+        #: launched-but-unmaterialized epoch read batches between the
+        #: dispatcher and the writeback worker.  BOUNDED: a lagging
+        #: writeback stage backpressures the dispatcher (which then
+        #: backpressures the bounded batch gate) instead of queueing
+        #: device handles without limit.
+        self._writeback_q: "queue.Queue" = queue.Queue(maxsize=16)
+        #: the LOCKED plane's feed: update groups and reads the epoch
+        #: cannot serve, processed by a dedicated worker so a commit
+        #: group (or an XLA compile hiding inside one) never parks the
+        #: dispatcher's read-launch stage.  BOUNDED: past the cap the
+        #: work sheds with a typed busy error, same as the batch gate.
+        self._locked_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        self._ticker_stop = threading.Event()
         if batch_static:
             self._batcher = threading.Thread(
                 target=self._static_loop, daemon=True,
                 name="antidote-proto-batch",
             )
             self._batcher.start()
+            self._writeback = threading.Thread(
+                target=self._writeback_loop, daemon=True,
+                name="antidote-proto-writeback",
+            )
+            self._writeback.start()
+            self._locked_worker = threading.Thread(
+                target=self._locked_loop, daemon=True,
+                name="antidote-proto-locked",
+            )
+            self._locked_worker.start()
+        #: the ticker runs whenever a txn manager exists — even with the
+        #: epoch plane disabled (gr protocol / epoch_tick_ms <= 0) it
+        #: still drives the LOCKED path's per-table epoch ladder, which
+        #: used to piggyback on static-batch traffic
+        self._ticker_runs = bool(batch_static and txm is not None)
+        if self._ticker_runs:
+            self._ticker = threading.Thread(
+                target=self._epoch_ticker, daemon=True,
+                name="antidote-epoch-ticker",
+            )
+            self._ticker.start()
         #: connection cap (the reference's ranch listener caps at 1024,
         #: /root/reference/src/antidote_pb_sup.erl:47-56).  The accept
         #: loop blocks on the semaphore when the cap is reached, so
@@ -219,10 +308,12 @@ class ProtocolServer:
                 except OSError:
                     client_id = f"conn{next(server_self._conn_ids)}"
                 metrics = server_self.metrics
+                # buffered framing: header + body in ~one syscall each
+                rfile = self.request.makefile("rb")
                 while True:
                     try:
-                        frame = read_frame(self.request)
-                    except (ConnectionError, OSError):
+                        frame = read_frame_buffered(rfile)
+                    except (ConnectionError, OSError, ValueError):
                         return
                     # ADMISSION (PR 4): acquire an in-flight slot before
                     # any decode/dispatch work.  Past the global or
@@ -237,6 +328,9 @@ class ProtocolServer:
                         if not self._reply_error(frame, "busy", e):
                             return
                         continue
+                    # decode-stage clock: runs until the work parks at
+                    # the batch gate (observed in _submit)
+                    server_self._tls.t0 = t0
                     try:
                         if not self._handle_admitted(frame, conn_txns):
                             return
@@ -314,7 +408,11 @@ class ProtocolServer:
                         "error": type(e).__name__, "detail": str(e)
                     }
                 try:
-                    write_message(self.request, resp_code, resp)
+                    if isinstance(resp, RawReply):
+                        # the writeback stage already framed the reply
+                        self.request.sendall(resp.buf)
+                    else:
+                        write_message(self.request, resp_code, resp)
                 except (ConnectionError, OSError):
                     return False
                 return True
@@ -331,15 +429,55 @@ class ProtocolServer:
     # ------------------------------------------------------------------
     # static batch gate
     # ------------------------------------------------------------------
-    def static_read(self, objects, clock, deadline=None):
-        """Batched static read: (values, snapshot_vc)."""
+    def static_read(self, objects, clock, deadline=None, wants_bytes=False):
+        """Batched static read: (values, snapshot_vc) — or a
+        :class:`RawReply` when ``wants_bytes`` and the writeback stage
+        serialized the native reply frame itself."""
         if not self.batch_static:
             with self._lock:
                 check_deadline(deadline, "dispatch")
                 return self.node.read_objects(objects, clock=_vc(clock))
-        return self._submit(_StaticWork("read", objects=objects,
-                                        clock=_vc(clock),
-                                        deadline=deadline))
+        clock_vc = _vc(clock)
+        fast = self._try_cache_read(objects, clock_vc, wants_bytes)
+        if fast is not None:
+            return fast
+        w = _StaticWork("read", objects=objects, clock=clock_vc,
+                        deadline=deadline, wants_bytes=wants_bytes)
+        out = self._submit(w)
+        if w.reply_bytes is not None:
+            return RawReply(w.reply_bytes)
+        return out
+
+    def _try_cache_read(self, objects, clock, wants_bytes):
+        """Hot-key fast path, ON the handler thread: when every object of
+        an epoch-eligible read resolves from the snapshot cache (or is
+        bottom at the epoch), the reply is served right here — no gate,
+        no dispatcher hop, no device work.  Returns the reply or None.
+
+        No epoch pin: this path touches only host-side structures (cache
+        entries, directory, the epoch's used-rows snapshot) — never the
+        frozen device buffers the pin protects."""
+        if not self._epoch_reads:
+            return None
+        txm = self.node.txm
+        store = txm.store
+        ep = store.serving_epoch
+        if ep is None:
+            return None
+        if int(ep.vc[txm.my_dc]) < txm.epoch_lag_counter:
+            return None
+        if clock is not None and not (clock <= ep.vc).all():
+            return None
+        vals = store.epoch_cache_read(objects, ep)
+        if vals is None:
+            return None
+        vc_list = [int(x) for x in ep.vc]
+        if wants_bytes:
+            return RawReply(encode(MessageCode.READ_OBJECTS_RESP, {
+                "values": [encode_value(v) for v in vals],
+                "commit_clock": vc_list,
+            }))
+        return vals, vc_list
 
     def static_update(self, updates, clock, deadline=None):
         """Batched static update: commit VC (raises AbortError on cert)."""
@@ -354,6 +492,12 @@ class ProtocolServer:
     def _submit(self, work: _StaticWork):
         if self._closing:
             raise ConnectionError("server shutting down")
+        now = time.monotonic()
+        work.t_submit = now
+        t0 = getattr(self._tls, "t0", None)
+        if t0 is not None:
+            self.metrics.stage_decode_seconds.observe(now - t0)
+            self._tls.t0 = None
         try:
             # bounded gate: shed with a typed busy error instead of
             # parking behind an unbounded backlog
@@ -371,103 +515,346 @@ class ProtocolServer:
             raise work.error
         return work.result
 
-    def _static_loop(self):
-        """The batch dispatcher: drain whatever has queued while the
-        previous group executed, run updates as ONE group commit and reads
-        as ONE merged snapshot read.  Natural batching — no gather delay:
-        at low load a lone request runs immediately; under load the batch
-        grows to whatever queued during the previous launch."""
-        q = self._static_q
-        while True:
-            first = q.get()
-            batch = [first]
-            while len(batch) < self._batch_max:
-                try:
-                    batch.append(q.get_nowait())
-                except queue.Empty:
-                    break
-            stop = any(w is _STOP for w in batch)
-            works: List[_StaticWork] = [w for w in batch if w is not _STOP]
-            self.metrics.commit_gate_depth.set(q.qsize())
-            # deadline discipline: work that outlived its caller while
-            # parked is aborted AT DEQUEUE — executing it would burn a
-            # device launch on a reply nobody is waiting for
-            live: List[_StaticWork] = []
-            for w in works:
-                if w.deadline is not None and time.monotonic() > w.deadline:
-                    self.metrics.shed.inc(plane="deadline")
-                    w.error = DeadlineExceeded(
-                        "request deadline passed while parked at the "
-                        "batch gate; not executed")
-                    w.event.set()
-                else:
-                    live.append(w)
-            works = live
+    def _drain_batch(self, q):
+        """Block for one work, drain whatever else queued (up to
+        ``_batch_max``).  Returns (works, stop_seen)."""
+        batch = [q.get()]
+        while len(batch) < self._batch_max:
             try:
-                ups = [w for w in works if w.kind == "update"]
+                batch.append(q.get_nowait())
+            except queue.Empty:
+                break
+        stop = any(w is _STOP for w in batch)
+        return [w for w in batch if w is not _STOP], stop
+
+    def _shed_expired(self, works, where: str, observe_parked=False):
+        """Deadline discipline shared by both planes: work that outlived
+        its caller while parked is aborted AT DEQUEUE — executing it
+        would burn a device launch on a reply nobody is waiting for."""
+        live: List[_StaticWork] = []
+        now = time.monotonic()
+        m = self.metrics
+        for w in works:
+            if observe_parked and w.t_submit:
+                m.stage_parked_seconds.observe(now - w.t_submit)
+            if w.deadline is not None and now > w.deadline:
+                m.shed.inc(plane="deadline")
+                w.error = DeadlineExceeded(
+                    f"request deadline passed while parked at the "
+                    f"{where}; not executed")
+                w.event.set()
+            else:
+                live.append(w)
+        return live
+
+    @staticmethod
+    def _fail_queue_remainder(q) -> None:
+        """Shutdown drain: fail anything that raced the stop sentinel
+        into the queue — a handler parked behind it must not wait out
+        its submit timeout."""
+        while True:
+            try:
+                w = q.get_nowait()
+            except queue.Empty:
+                return
+            if w is not _STOP:
+                w.error = ConnectionError("server shutting down")
+                w.event.set()
+
+    def _static_loop(self):
+        """The DISPATCHER stage of the serving pipeline: drain whatever
+        queued while the previous group executed, LAUNCH merged epoch
+        reads lock-free (device handles go to the writeback stage — this
+        thread never blocks on the device), and forward everything else
+        to the locked-plane worker.  Natural batching — no gather delay:
+        at low load a lone request runs immediately; under load the
+        batch grows to whatever queued during the previous launch, and
+        batch N+1 is being decoded by handler threads while batch N
+        executes on device and batch N-1's replies are serialized by the
+        writeback worker."""
+        q = self._static_q
+        m = self.metrics
+        while True:
+            works, stop = self._drain_batch(q)
+            m.commit_gate_depth.set(q.qsize())
+            works = self._shed_expired(works, "batch gate",
+                                       observe_parked=True)
+            try:
                 reads = [w for w in works if w.kind == "read"]
-                with self._lock:
-                    # updates first: the merged read then serves at a
-                    # snapshot covering them (fresh-path + cache friendly)
-                    if ups:
-                        self._run_update_group(ups)
-                    if reads:
-                        self._run_read_group(reads)
-                    self._maybe_publish_epochs()
+                rest = [w for w in works if w.kind != "read"]
+                if reads and self._epoch_reads:
+                    # lock-split: reads pinned at/below the published
+                    # serving epoch never park behind a commit group
+                    t0 = time.monotonic()
+                    reads = self._launch_epoch_reads(reads)
+                    m.stage_launch_seconds.observe(time.monotonic() - t0)
+                # updates and unservable reads go to the locked-plane
+                # worker: a commit group (or the compile hiding inside
+                # one) never parks the dispatcher's launch stage.
+                # path=locked counts only reads actually enqueued — a
+                # queue-full shed is not a served read (a rerouted
+                # work's already-launched objects still show under
+                # gather: a real, if wasted, launch)
+                for w in rest + reads:
+                    try:
+                        self._locked_q.put_nowait(w)
+                    except queue.Full:
+                        m.shed.inc(plane="server_queue")
+                        w.error = BusyError(
+                            f"static batch gate full (locked plane: "
+                            f"{self._locked_q.maxsize} parked)",
+                            retry_after_ms=100)
+                        w.event.set()
+                        continue
+                    if w.kind == "read":
+                        m.serving_reads.inc(len(w.objects), path="locked")
             except BaseException as e:  # never strand a parked connection
                 for w in works:
                     if not w.event.is_set():
                         w.error = e
                         w.event.set()
             if stop:
-                # fail anything that raced the shutdown into the queue —
-                # a handler parked behind the sentinel must not wait out
-                # its submit timeout
-                while True:
-                    try:
-                        w = q.get_nowait()
-                    except queue.Empty:
-                        return
-                    if w is not _STOP:
-                        w.error = ConnectionError("server shutting down")
+                self._locked_q.put(_STOP)
+                self._fail_queue_remainder(q)
+                return
+
+    def _locked_loop(self):
+        """The LOCKED plane's worker: update group commits and the reads
+        the epoch path cannot serve (clocks ahead of the epoch, composite
+        maps, promoted keys, no epoch yet).  Runs under ``self._lock`` —
+        serialized against nothing but itself and inline (batch_static
+        off) dispatch; the epoch read plane never waits for it."""
+        q = self._locked_q
+        while True:
+            works, stop = self._drain_batch(q)
+            # re-checked at THIS dequeue too: a work can expire while
+            # parked behind a slow commit group (this plane's whole job
+            # is absorbing those)
+            works = self._shed_expired(works, "locked plane")
+            try:
+                ups = [w for w in works if w.kind == "update"]
+                reads = [w for w in works if w.kind == "read"]
+                with self._lock:
+                    # updates first: the merged read then serves at a
+                    # snapshot covering them (fresh + cache friendly)
+                    if ups:
+                        self._run_update_group(ups)
+                    if reads:
+                        self._run_read_group(reads)
+            except BaseException as e:  # never strand a parked connection
+                for w in works:
+                    if not w.event.is_set():
+                        w.error = e
                         w.event.set()
+            if stop:
+                self._fail_queue_remainder(q)
+                return
 
-    #: serving-epoch publication cadence (seconds): each tick freezes the
-    #: tables' heads so reads pinned at/below that snapshot stay pure
-    #: gathers while writes advance (the read-while-write double buffer —
-    #: without a production publisher the epoch machinery would only ever
-    #: run in benchmarks)
-    EPOCH_PUBLISH_S = 2.0
-    _last_epoch_pub = 0.0
-    _epoch_pub_mutations = -1
+    # ------------------------------------------------------------------
+    # lock-split epoch reads (dispatcher launch stage)
+    # ------------------------------------------------------------------
+    def _launch_epoch_reads(
+            self, works: List[_StaticWork]) -> List[_StaticWork]:
+        """Launch epoch-eligible read works as merged lock-free gathers
+        against the frozen serving epoch (async dispatch only — never a
+        device sync) and hand the device handles to the writeback stage.
+        Returns the works that must take the locked path: clocks ahead
+        of the epoch, objects the epoch cannot serve (composite maps,
+        promoted keys, unfrozen tables), or no epoch at all."""
+        leftover: List[_StaticWork] = []
+        for chunk in self._chunk_epoch_works(works):
+            leftover.extend(self._launch_epoch_chunk(chunk))
+        return leftover
 
-    def _maybe_publish_epochs(self) -> None:
-        txm = getattr(self.node, "txm", None)
-        if txm is None:
-            return  # cluster members publish at their own stores
-        import time as _t
-
-        now = _t.monotonic()
-        if now - self._last_epoch_pub < self.EPOCH_PUBLISH_S:
-            return
+    def _launch_epoch_chunk(
+            self, works: List[_StaticWork]) -> List[_StaticWork]:
+        """One bounded launch chunk: pin the epoch, classify, launch ONE
+        merged gather, enqueue for writeback.  Returns locked-path works."""
+        txm = self.node.txm
         store = txm.store
-        # freeze a table when (a) new commits landed since its last
-        # freeze AND (b) some read actually took the slow path since
-        # then — (a) alone copies heads for workloads that never fold,
-        # (b) alone is satisfied forever by one old historical read.
-        # Checked PER TABLE so a slow read arriving after writes
-        # quiesced still gets its epoch on the next tick (the global
-        # early-return variant starved exactly that case).
-        published = False
-        for t in store.tables.values():
-            if (t.slow_serves != getattr(t, "_pub_slow_serves", -1)
-                    and store.mutation_epoch != getattr(t, "_pub_mut", -1)):
-                t._pub_slow_serves = t.slow_serves
-                t._pub_mut = store.mutation_epoch
-                t.publish_epoch()
-                published = True
-        if published:
-            self._last_epoch_pub = now
+        ep = store.pin_serving_epoch()
+        if ep is None:
+            return works
+        # a clockless read must still see every locally-ACKED commit.
+        # Commit groups publish BEFORE replying, so acked == covered —
+        # except across a deferred/failed publish, which raises the lag
+        # floor; an epoch below the floor cannot serve clockless reads.
+        # (Deliberately NOT commit_counter: a commit minted mid-flight
+        # has not acked yet, and gating on it would park reads behind
+        # every in-flight commit — the convoy this plane removes.)
+        if int(ep.vc[txm.my_dc]) < txm.epoch_lag_counter:
+            store.unpin_serving_epoch(ep)
+            return works
+        merged: List[_StaticWork] = []
+        locked: List[_StaticWork] = []
+        for w in works:
+            if w.clock is None or (w.clock <= ep.vc).all():
+                merged.append(w)
+            else:
+                locked.append(w)
+        if not merged:
+            store.unpin_serving_epoch(ep)
+            return works
+        objs: list = []
+        spans = []
+        for w in merged:
+            spans.append((len(objs), len(objs) + len(w.objects)))
+            objs.extend(w.objects)
+        try:
+            pending, fallback = store.epoch_read_launch(objs, ep)
+        except BaseException:
+            store.unpin_serving_epoch(ep)
+            log.exception("epoch read launch failed; locked fallback")
+            return works
+        keep, kspans = merged, spans
+        if fallback:
+            fb = set(fallback)
+            keep, kspans = [], []
+            for w, (lo, hi) in zip(merged, spans):
+                if fb.isdisjoint(range(lo, hi)):
+                    keep.append(w)
+                    kspans.append((lo, hi))
+                else:
+                    # a work with ANY unservable object reroutes whole —
+                    # its launched siblings' results are simply dropped
+                    locked.append(w)
+        if not keep:
+            store.unpin_serving_epoch(ep)
+            return locked
+        vc_list = [int(x) for x in ep.vc]
+        # bounded handoff: a lagging writeback stage backpressures this
+        # dispatcher (and through the bounded gate, the clients)
+        self._writeback_q.put(_EpochReadBatch(pending, keep, kspans,
+                                              vc_list))
+        return locked
+
+    #: merged epoch-read launches are chunked at this many objects: one
+    #: padded-batch XLA bucket serves every chunk, so a saturated gate
+    #: can never mint a brand-new (bigger) bucket shape — and its
+    #: multi-second compile — in the middle of serving traffic
+    EPOCH_LAUNCH_CHUNK = 512
+
+    def _chunk_epoch_works(self, works: List[_StaticWork]):
+        """Split eligible works into launch chunks of ≤ EPOCH_LAUNCH_CHUNK
+        total objects (a single oversized work still gets its own chunk —
+        the bucket ladder handles it)."""
+        chunk: List[_StaticWork] = []
+        n = 0
+        for w in works:
+            if chunk and n + len(w.objects) > self.EPOCH_LAUNCH_CHUNK:
+                yield chunk
+                chunk, n = [], 0
+            chunk.append(w)
+            n += len(w.objects)
+        if chunk:
+            yield chunk
+
+    def _writeback_loop(self):
+        """The WRITEBACK stage: the only pipeline stage allowed to block
+        on the device.  Materializes launched epoch-read batches, decodes
+        values (back-filling the hot-key snapshot cache), serializes the
+        native reply frames in one tight loop, and wakes the parked
+        handler threads."""
+        q = self._writeback_q
+        m = self.metrics
+        while True:
+            batch = q.get()
+            if batch is _STOP:
+                return
+            store = self.node.txm.store
+            t0 = time.monotonic()
+            try:
+                # sync-ok: the writeback stage owns the device sync
+                vals = store.epoch_read_finish(batch.pending)
+                for w, (lo, hi) in zip(batch.works, batch.spans):
+                    w.result = (vals[lo:hi], batch.vc_list)
+                    if w.wants_bytes:
+                        w.reply_bytes = encode(
+                            MessageCode.READ_OBJECTS_RESP, {
+                                "values": [encode_value(v)
+                                           for v in vals[lo:hi]],
+                                "commit_clock": batch.vc_list,
+                            })
+                    w.event.set()
+            except BaseException as e:
+                log.exception("epoch read writeback failed")
+                for w in batch.works:
+                    if not w.event.is_set():
+                        w.error = e
+                        w.event.set()
+            finally:
+                store.unpin_serving_epoch(batch.pending.ep)
+                m.stage_writeback_seconds.observe(time.monotonic() - t0)
+
+    # ------------------------------------------------------------------
+    # serving-epoch ticker (dedicated publication thread)
+    # ------------------------------------------------------------------
+    #: per-table cadence of the LOCKED path's epoch ladder
+    #: (TypedTable.publish_epoch full-head copies)
+    TABLE_EPOCH_S = 2.0
+    #: at most this many full-head table publishes per tick — the
+    #: per-tick publication cost cap (a tick can no longer stall the
+    #: pipeline for one whole-store copy sweep)
+    TABLE_EPOCHS_PER_TICK = 1
+
+    def _epoch_ticker(self):
+        """Publishes serving epochs on a fixed cadence so an
+        interactive-txn-only (or remote-ingress-only) workload still gets
+        fresh epochs — commit groups publish inline before their acks,
+        the ticker covers everything else (including deferred-publish
+        retries).  Runs OFF the dispatcher thread: a publication tick can
+        never stall a parked read batch (reads don't take the lock the
+        publish holds)."""
+        txm = self.node.txm
+        # with the epoch plane off, the ticker still drives the table
+        # ladder — at a relaxed cadence (the ladder's own per-table
+        # cadence is TABLE_EPOCH_S anyway)
+        tick = (max(float(self.epoch_tick_ms), 1.0) / 1e3
+                if self._epoch_reads else 0.5)
+        while not self._ticker_stop.wait(tick):
+            try:
+                if self._epoch_reads:
+                    txm.publish_serving_epoch()
+                self._publish_table_epochs_capped()
+            except Exception:
+                log.exception("epoch ticker publish failed")
+
+    def _publish_table_epochs_capped(self) -> int:
+        """The locked path's per-table epoch ladder (read-while-write
+        double buffer for clock-pinned reads), budgeted: at most
+        ``TABLE_EPOCHS_PER_TICK`` full-head copies per tick, each table
+        at most every ``TABLE_EPOCH_S``.  A table publishes only when new
+        commits landed AND some read actually took the slow path since
+        its last publish — (a) alone copies heads for workloads that
+        never fold, (b) alone is satisfied forever by one old historical
+        read.  Returns the number of tables published."""
+        txm = self.node.txm
+        store = txm.store
+        budget = self.TABLE_EPOCHS_PER_TICK
+        published = 0
+        now = time.monotonic()
+        with txm.commit_lock:
+            # least-recently-published first: with more continuously-
+            # eligible tables than budget slots per cadence window, a
+            # fixed scan order would starve the tables at the tail of
+            # the dict forever
+            tables = sorted(store.tables.values(),
+                            key=lambda t: getattr(t, "_pub_at", 0.0))
+            for t in tables:
+                if budget == 0:
+                    break
+                if (t.slow_serves != getattr(t, "_pub_slow_serves", -1)
+                        and store.mutation_epoch != getattr(t, "_pub_mut",
+                                                            -1)
+                        and now - getattr(t, "_pub_at", 0.0)
+                        >= self.TABLE_EPOCH_S):
+                    t._pub_slow_serves = t.slow_serves
+                    t._pub_mut = store.mutation_epoch
+                    t._pub_at = now
+                    t.publish_epoch()
+                    budget -= 1
+                    published += 1
+        return published
 
     def _run_read_group(self, works: List[_StaticWork]) -> None:
         # requests whose causal clock is already covered locally merge
@@ -519,6 +906,7 @@ class ProtocolServer:
             return vc
         member = getattr(self.node, "member", None)
         if member is not None:
+            # sync-ok: cluster members return host clocks, not jax arrays
             return np.asarray(member.stable_vc())
         return None
 
@@ -608,10 +996,15 @@ class ProtocolServer:
         # — the ONLY static dispatch path, so it cannot drift from a
         # duplicate
         if code == MessageCode.STATIC_READ_OBJECTS:
-            vals, vc = self.static_read(
+            out = self.static_read(
                 _decode_objects(body["objects"]), body.get("clock"),
-                deadline=deadline,
+                deadline=deadline, wants_bytes=True,
             )
+            if isinstance(out, RawReply):
+                # batched reply serialization: the writeback stage framed
+                # the response; the handler sends the bytes as-is
+                return MessageCode.READ_OBJECTS_RESP, out
+            vals, vc = out
             return MessageCode.READ_OBJECTS_RESP, {
                 "values": [encode_value(v) for v in vals],
                 "commit_clock": [int(x) for x in vc],
@@ -700,6 +1093,7 @@ class ProtocolServer:
                 "batch_gate_depth": self._static_q.qsize(),
                 "batch_gate_max": self._static_q.maxsize,
             })
+            status["pipeline"] = self._pipeline_status()
             return MessageCode.OPERATION_RESP, {"status": status}
         raise ValueError(f"unhandled message code {code!r}")
 
@@ -739,12 +1133,60 @@ class ProtocolServer:
             )
 
     # ------------------------------------------------------------------
+    def _pipeline_status(self) -> dict:
+        """Stage-timing + serving-plane block for node status — the
+        server-side breakdown the wire bench freezes into its artifact
+        (decode / parked / launch / writeback µs per stage)."""
+        m = self.metrics
+
+        def us(h):
+            s = h.summary()
+            return {
+                "count": s["count"],
+                "sum_ms": round(s["count"] * s["mean"] * 1e3, 3),
+                "mean_us": round(s["mean"] * 1e6, 1),
+                "p50_us": round(s["p50"] * 1e6, 1),
+                "p99_us": round(s["p99"] * 1e6, 1),
+            }
+
+        out = {
+            "epoch_reads": self._epoch_reads,
+            "stages": {
+                "decode": us(m.stage_decode_seconds),
+                "parked": us(m.stage_parked_seconds),
+                "launch": us(m.stage_launch_seconds),
+                "writeback": us(m.stage_writeback_seconds),
+            },
+            "reads": {
+                path[0]: int(v)
+                for path, v in sorted(m.serving_reads.snapshot().items())
+            },
+            "snapshot_cache": {
+                ev[0]: int(v)
+                for ev, v in sorted(m.snapshot_cache.snapshot().items())
+            },
+            "epoch_publish": {
+                mode[0]: int(v)
+                for mode, v in sorted(m.epoch_publish.snapshot().items())
+            },
+            "serving_epoch_id": int(m.serving_epoch_id.value()),
+            "writeback_depth": self._writeback_q.qsize(),
+            "locked_depth": self._locked_q.qsize(),
+        }
+        txm = getattr(self.node, "txm", None)
+        if txm is not None:
+            out["snapshot_cache"]["size"] = len(txm.store.snapshot_cache)
+            out["snapshot_cache"]["cap"] = txm.store.snapshot_cache_cap
+        return out
+
+    # ------------------------------------------------------------------
     def is_alive(self) -> bool:
         """Supervision probe (supervise.Supervisor child health)."""
         return self._thread.is_alive()
 
     def close(self) -> None:
         self._closing = True
+        self._ticker_stop.set()
         self._server.shutdown()
         self._server.server_close()
         if self.batch_static:
@@ -760,4 +1202,24 @@ class ProtocolServer:
                         break  # dispatcher wedged; it is a daemon thread
                     time.sleep(0.05)
             self._batcher.join(timeout=5)
+            # stop the writeback stage AFTER the dispatcher: in-flight
+            # launched batches still get materialized and replied.
+            # Fresh grace window — the gate put loop + batcher join may
+            # have consumed the earlier one entirely, and giving up on
+            # the first Full would drop in-flight replies.
+            stop_by = time.monotonic() + 5.0
+            while True:
+                try:
+                    self._writeback_q.put_nowait(_STOP)
+                    break
+                except queue.Full:
+                    if time.monotonic() >= stop_by:
+                        break
+                    time.sleep(0.05)
+            self._writeback.join(timeout=5)
+            # the dispatcher's stop path forwarded _STOP to the locked
+            # worker; it drains whatever raced in behind the sentinel
+            self._locked_worker.join(timeout=5)
+        if self._ticker_runs:
+            self._ticker.join(timeout=5)
         self._thread.join(timeout=5)

@@ -17,10 +17,10 @@ from typing import Any, Dict, List, Sequence, Tuple
 import numpy as np
 
 from antidote_tpu.config import AntidoteConfig
-from antidote_tpu.crdt import get_type
+from antidote_tpu.crdt import get_type, is_type
 from antidote_tpu.crdt.blob import BlobStore
 from antidote_tpu.store.router import shard_batch, shard_of
-from antidote_tpu.store.typed_table import TypedTable
+from antidote_tpu.store.typed_table import TypedTable, _bucket
 
 BoundObject = Tuple[Any, str, str]  # (key, type_name, bucket)
 
@@ -187,6 +187,58 @@ def _make_promote_fn():
 #: distinct miss marker (None is a legitimate cached value)
 _CACHE_MISS = object()
 
+
+class ServingEpoch:
+    """One published store-wide serving snapshot (ISSUE 5 lock-split).
+
+    ``vc`` is the snapshot clock E: every applied op is ≤ E entry-wise and
+    every op applied after publication is invisible at E (local commits
+    mint own-lane counters above E; remote chains apply in op-id order, so
+    their next op's origin lane exceeds E too).  ``tables`` maps tiered
+    table names to frozen (head, head_vc, cap) buffers exact at E;
+    ``used_rows`` snapshots row allocation so rows born after publication
+    read as bottom; ``promoted`` collects keys tier-promoted after
+    publication (their frozen location went stale — readers fall back).
+
+    Readers pin the epoch (under the store's epoch lock) for the lifetime
+    of a launch+writeback so a later publish never donates buffers a
+    lock-free gather still references.
+    """
+
+    __slots__ = ("id", "prev_id", "vc", "mut_epoch", "tables", "used_rows",
+                 "touched", "promoted", "pins", "born")
+
+    def __init__(self, id_, prev_id, vc, mut_epoch, tables, used_rows,
+                 touched):
+        self.id = id_
+        self.prev_id = prev_id
+        self.vc = vc
+        self.mut_epoch = mut_epoch
+        self.tables = tables
+        self.used_rows = used_rows
+        #: tname -> frozenset of (shard, row) re-frozen at THIS publish
+        #: (None = full copy / unknown) — drives snapshot-cache
+        #: revalidation across epoch advances for untouched keys
+        self.touched = touched
+        self.promoted: set = set()
+        self.pins = 0
+        import time as _time
+
+        self.born = _time.monotonic()
+
+
+class _EpochReadPending:
+    """Launched-but-unmaterialized epoch read batch: device handles only
+    (the dispatcher stage must never sync)."""
+
+    __slots__ = ("ep", "objects", "vals", "launches")
+
+    def __init__(self, ep, objects, vals, launches):
+        self.ep = ep
+        self.objects = objects
+        self.vals = vals
+        self.launches = launches
+
 #: composite-key namespaces (crdt/maps.py field_key/member_key): an effect
 #: on a derived key must also invalidate the PARENT map's cached value
 _DERIVED_NS = ("\x00mapfield", "\x00mapmember")
@@ -256,6 +308,40 @@ class KVStore:
         #: (and on first use a compile), which made every hot-key tier
         #: crossing a serving latency spike
         self._promote_fns: Dict[Tuple[str, str], Any] = {}
+        # --- serving epochs + hot-key snapshot cache (ISSUE 5) ---------
+        #: NodeMetrics (attached by AntidoteNode) — snapshot-cache and
+        #: epoch-publish counters land here when present
+        self.metrics = None
+        #: the last published store-wide serving snapshot (ServingEpoch)
+        self.serving_epoch: "ServingEpoch | None" = None
+        self._serving_seq = 0
+        #: retired epochs whose reader pins have not drained yet — a
+        #: publish may only donate spare buffers once this is pin-free
+        #: (bounded-by: pruned to pinned entries at every publish; pins
+        #: drain with each read batch)
+        self._epoch_graveyard: List["ServingEpoch"] = []
+        self._epoch_lock = _threading.Lock()
+        #: hot-key snapshot cache: (key, bucket) -> (epoch_id, location,
+        #: decoded value) — the TPU-side analogue of materializer_vnode's
+        #: snapshot cache (/root/reference/src/materializer_vnode.erl:37-39):
+        #: a Zipfian-hot key re-read at an unchanged epoch is a dict hit
+        #: that skips the gather/decode entirely.  Invalidated by epoch
+        #: advance (entries carry their epoch id; an entry from the
+        #: immediately-previous epoch revalidates iff its row was not
+        #: re-frozen).  LRU-bounded.
+        self.snapshot_cache: "_OD[Tuple[Any, str], tuple]" = _OD()
+        self.snapshot_cache_cap = 65536
+        self._snapshot_cache_lock = _threading.Lock()
+        #: publish history: epoch id -> {tname: frozenset of re-frozen
+        #: (shard, row) | None=full copy} — lets a cache entry from N
+        #: epochs ago revalidate by proving its row untouched across
+        #: every publish since (Zipf-tail keys survive arbitrarily many
+        #: epoch advances; any gap or copy in the chain = miss).
+        #: bounded-by: _EPOCH_HISTORY entries, pruned at every publish
+        self._epoch_touch_log: "_OD[int, dict]" = _OD()
+        #: decoded bottom (never-written) value per type — served for
+        #: keys born after the epoch without any device work
+        self._bottom_values: Dict[str, Any] = {}
 
     def _is_slotted(self, type_name: str) -> bool:
         hit = self._slotted.get(type_name)
@@ -278,6 +364,10 @@ class KVStore:
             t = TypedTable(
                 get_type(base), cfg, n_rows=n_rows, sharding=self.sharding
             )
+            # out-of-band mutations (grow/promote/handoff) invalidate the
+            # table's frozen serving buffers; the store-wide epoch that
+            # references them must die with them
+            t.on_serving_invalidate = self.drop_serving_epoch
             self.tables[tname] = t
         return t
 
@@ -437,6 +527,350 @@ class KVStore:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
 
     # ------------------------------------------------------------------
+    # serving epochs (lock-split wire reads — ISSUE 5)
+    # ------------------------------------------------------------------
+    def pin_serving_epoch(self) -> "ServingEpoch | None":
+        """Grab + pin the current serving epoch (None when none is
+        published).  The pin keeps a later publish from donating frozen
+        buffers a lock-free gather still references; release with
+        :meth:`unpin_serving_epoch` once the batch is materialized."""
+        with self._epoch_lock:
+            ep = self.serving_epoch
+            if ep is not None:
+                ep.pins += 1
+            return ep
+
+    def unpin_serving_epoch(self, ep: "ServingEpoch") -> None:
+        with self._epoch_lock:
+            ep.pins -= 1
+
+    def drop_serving_epoch(self) -> None:
+        """Retire the current epoch without a successor (out-of-band
+        table mutation): lock-free reads fall back to the locked path
+        until the next publish."""
+        with self._epoch_lock:
+            ep = self.serving_epoch
+            if ep is not None:
+                self.serving_epoch = None
+                self._epoch_graveyard.append(ep)
+
+    def publish_serving_epoch(self, vc: np.ndarray) -> str:
+        """Publish a new store-wide serving snapshot at clock ``vc``.
+
+        Caller must hold the commit lock (``vc`` and the frozen heads
+        must be captured with no concurrent apply).  Dirty tables are
+        re-frozen — incrementally where their spare buffer can be
+        donated (cost ∝ rows written since the last freeze, NOT table
+        size), by full copy on the first freezes or after invalidation.
+        Returns "published", "noop" (epoch already current) or
+        "deferred" (a reader still pins a retired epoch whose buffers
+        the freeze would donate — retried on the next publish trigger).
+        """
+        cur = self.serving_epoch
+        if cur is not None and cur.mut_epoch == self.mutation_epoch:
+            return "noop"
+        m = self.metrics
+        with self._epoch_lock:
+            can_donate = all(e.pins == 0 for e in self._epoch_graveyard)
+            if can_donate:
+                # unpinned retired epochs are unreachable (readers only
+                # ever pin the current one): their buffer refs drop here,
+                # freeing the spare slots for donation
+                self._epoch_graveyard.clear()
+        slots: Dict[str, dict] = {}
+        used: Dict[str, np.ndarray] = {}
+        touched: Dict[str, Any] = {}
+        for tname, t in self.tables.items():
+            # write-windows frozen by EARLIER publish attempts that then
+            # deferred: their rows must stay in this epoch's touched set
+            # or cache entries would revalidate across those writes
+            pend = getattr(t, "_pending_touched", frozenset())
+            if t.serving_slot() is None or t.serving_dirty():
+                # a PARTIAL earlier publish (mid-loop defer) can leave the
+                # LIVE epoch referencing this table's spare slot: donating
+                # it would delete buffers a lock-free gather still reads.
+                # Waiting can never free it (it stays live until a publish
+                # succeeds, which needs this freeze) — rebuild by copy.
+                spare_live = (cur is not None
+                              and cur.tables.get(tname) is t.serving_spare())
+                res = t.freeze_serving(can_donate and not spare_live,
+                                       force_copy=spare_live)
+                if res is None:
+                    if m is not None:
+                        m.epoch_publish.inc(mode="defer")
+                    return "deferred"
+                slot, mode, tch, rows = res
+                tch = None if (tch is None or pend is None) else tch | pend
+                t._pending_touched = tch
+                touched[tname] = tch
+                if m is not None:
+                    m.epoch_publish.inc(mode=mode)
+                    m.epoch_rows.inc(rows, mode=mode)
+            else:
+                touched[tname] = pend  # clean since the last success
+            slots[tname] = t.serving_slot()
+            used[tname] = t.used_rows.copy()
+        self._serving_seq += 1
+        ep = ServingEpoch(
+            self._serving_seq, cur.id if cur is not None else None,
+            np.asarray(vc, np.int32), self.mutation_epoch, slots, used,
+            touched,
+        )
+        with self._epoch_lock:
+            old = self.serving_epoch
+            self.serving_epoch = ep
+            self._epoch_graveyard = [
+                e for e in self._epoch_graveyard if e.pins > 0
+            ]
+            if old is not None:
+                self._epoch_graveyard.append(old)
+        with self._snapshot_cache_lock:
+            self._epoch_touch_log[ep.id] = touched
+            while len(self._epoch_touch_log) > self._EPOCH_HISTORY:
+                self._epoch_touch_log.popitem(last=False)
+        for t in self.tables.values():
+            t._pending_touched = frozenset()  # this epoch carries them
+        if m is not None:
+            m.serving_epoch_id.set(ep.id)
+        return "published"
+
+    # ------------------------------------------------------------------
+    # hot-key snapshot cache
+    # ------------------------------------------------------------------
+    #: publish-history retention (epochs): a cache entry older than this
+    #: many publishes can no longer prove itself untouched and misses
+    _EPOCH_HISTORY = 256
+
+    def epoch_cache_read(self, objects: Sequence[BoundObject],
+                         ep: "ServingEpoch"):
+        """Whole-batch cache fast path: decoded values for every object
+        from the snapshot cache and per-type bottoms alone — no device
+        work, no lock, no queue hop (the handler thread serves the reply
+        itself).  Returns None as soon as any object needs a gather or
+        the locked path; misses are then re-counted by the gate's launch,
+        so only hits are counted here."""
+        vals: List[Any] = []
+        n_hits = 0
+        for key, type_name, bucket in objects:
+            if not is_type(type_name):
+                return None
+            ty = get_type(type_name)
+            if getattr(ty, "composite", False):
+                return None
+            dk = (key, bucket)
+            hit = self.snapshot_cache_get(dk, ep, type_name, count=False)
+            if hit is not _CACHE_MISS:
+                vals.append(hit)
+                n_hits += 1
+                continue
+            # directory BEFORE the promoted check — the promotion path
+            # marks ep.promoted and THEN flips the directory (GIL-
+            # ordered), so a reader that sees the post-flip entry is
+            # guaranteed to see the mark and fall back; checking
+            # promoted first could miss the mark, then read the flipped
+            # entry and serve bottom for a key with data
+            ent = self.directory.get(dk)
+            if dk in ep.promoted:
+                return None
+            if ent is None:
+                vals.append(self._bottom_value(type_name))
+                continue
+            tname_t, shard, row = ent
+            ur = ep.used_rows.get(tname_t)
+            if (split_tier(tname_t)[0] == type_name and ur is not None
+                    and row >= ur[shard]):
+                # row born after the epoch: bottom at E
+                vals.append(self._bottom_value(type_name))
+                continue
+            return None  # needs a frozen-head gather (or the locked path)
+        if self.metrics is not None:
+            # counted only on WHOLE-batch success: a bailed batch is
+            # re-probed (and counted) by the gate's launch path
+            if n_hits:
+                self.metrics.snapshot_cache.inc(n_hits, event="hit")
+            self.metrics.serving_reads.inc(len(vals), path="cache")
+        return vals
+
+    def snapshot_cache_get(self, dk, ep: "ServingEpoch",
+                           type_name: str | None = None,
+                           count: bool = True):
+        """Cached decoded value for ``dk`` at epoch ``ep``, or the miss
+        marker.  A stale-stamped entry revalidates (and is re-stamped)
+        by walking the publish history: its row untouched by EVERY
+        publish since its stamp — Zipf-tail keys survive arbitrarily
+        many epoch advances; a written key's entry correctly misses (so
+        does anything older than the retained history, or spanning a
+        full-copy publish).
+
+        ``type_name``, when given, must match the entry's bound type: a
+        wrong-type read must take the miss path so the locked plane can
+        raise the same TypeError it raises on a cache-cold request —
+        cache residency must never change observable behavior.
+        ``count=False`` suppresses the hit/miss counters (batch callers
+        count once per batch)."""
+        m = self.metrics if count else None
+        with self._snapshot_cache_lock:
+            ent = self.snapshot_cache.get(dk)
+            if ent is not None:
+                eid, loc, value = ent
+                if (type_name is not None and loc is not None
+                        and split_tier(loc[0])[0] != type_name):
+                    ent = None  # bound to another type: miss -> TypeError
+            if ent is not None:
+                ok = eid == ep.id
+                if (not ok and eid < ep.id and loc is not None
+                        and dk not in ep.promoted):
+                    tname, shard, row = loc
+                    log_ = self._epoch_touch_log
+                    for e in range(eid + 1, ep.id + 1):
+                        tl = log_.get(e)
+                        tch = None if tl is None else tl.get(tname)
+                        if tch is None or (shard, row) in tch:
+                            break  # gap / full copy / row re-frozen
+                    else:
+                        self.snapshot_cache[dk] = (ep.id, loc, value)
+                        ok = True
+                if ok:
+                    self.snapshot_cache.move_to_end(dk)
+                    if m is not None:
+                        m.snapshot_cache.inc(event="hit")
+                    return _copy_out(value)
+        if m is not None:
+            m.snapshot_cache.inc(event="miss")
+        return _CACHE_MISS
+
+    def snapshot_cache_fill(self, dk, ep: "ServingEpoch", loc, value) -> None:
+        with self._snapshot_cache_lock:
+            self.snapshot_cache[dk] = (ep.id, loc, _copy_out(value))
+            while len(self.snapshot_cache) > self.snapshot_cache_cap:
+                self.snapshot_cache.popitem(last=False)
+                if self.metrics is not None:
+                    self.metrics.snapshot_cache.inc(event="evict")
+
+    def _bottom_value(self, type_name: str):
+        """Decoded client-visible value of a never-written key."""
+        hit = self._bottom_values.get(type_name)
+        if hit is None:
+            ty = get_type(type_name)
+            zero = {
+                f: np.zeros(shape, dtype)
+                for f, (shape, dtype) in ty.state_spec(self.cfg).items()
+            }
+            hit = ty.value(zero, self.blobs, self.cfg)
+            self._bottom_values[type_name] = hit
+        return _copy_out(hit)
+
+    # ------------------------------------------------------------------
+    # epoch reads: launch (dispatcher stage, never syncs) + finish
+    # (writeback stage, materializes and decodes)
+    # ------------------------------------------------------------------
+    def epoch_read_launch(self, objects: Sequence[BoundObject],
+                          ep: "ServingEpoch"):
+        """Resolve a batch of bound objects at epoch ``ep`` without any
+        lock and without any device sync: snapshot-cache hits and bottom
+        values are filled immediately; the misses are grouped per table
+        into frozen-head gather+resolve launches whose DEVICE handles ride
+        in the returned pending object.  Returns (pending, fallback_idx):
+        objects that cannot be served at the epoch (composite maps,
+        promoted keys, tables with no frozen buffer) are listed in
+        ``fallback_idx`` for the caller's locked path."""
+        n = len(objects)
+        vals: List[Any] = [None] * n
+        fallback: List[int] = []
+        need: Dict[str, list] = {}
+        m = self.metrics
+        n_cached = 0
+        for i, (key, type_name, bucket) in enumerate(objects):
+            ty = get_type(type_name) if is_type(type_name) else None
+            if ty is None or getattr(ty, "composite", False):
+                fallback.append(i)
+                continue
+            dk = (key, bucket)
+            hit = self.snapshot_cache_get(dk, ep, type_name)
+            if hit is not _CACHE_MISS:
+                vals[i] = hit
+                n_cached += 1
+                continue
+            ent = self.directory.get(dk)
+            if ent is None:
+                vals[i] = self._bottom_value(type_name)
+                continue
+            if dk in ep.promoted:
+                fallback.append(i)
+                continue
+            tname_t, shard, row = ent
+            if split_tier(tname_t)[0] != type_name:
+                fallback.append(i)  # type clash: locked path raises it
+                continue
+            slot = ep.tables.get(tname_t)
+            ur = ep.used_rows.get(tname_t)
+            if slot is None or ur is None:
+                fallback.append(i)
+                continue
+            if row >= ur[shard]:
+                # row allocated after the epoch: invisible at E
+                vals[i] = self._bottom_value(type_name)
+                continue
+            need.setdefault(tname_t, []).append((i, shard, row))
+        if m is not None and n_cached:
+            m.serving_reads.inc(n_cached, path="cache")
+        launches = []
+        for tname_t, items in need.items():
+            t = self.table(tname_t)
+            slot = ep.tables[tname_t]
+            mcount = len(items)
+            mb = _bucket(mcount, t.cfg.batch_buckets)
+            ss = np.zeros(mb, np.int64)
+            rr = np.zeros(mb, np.int64)
+            ss[:mcount] = [x[1] for x in items]
+            rr[:mcount] = [x[2] for x in items]
+            vcs = np.zeros((mb, ep.vc.shape[-1]), np.int32)
+            vcs[:mcount] = ep.vc
+            resolved, fresh = t._latest_resolved_flat_fn(
+                slot["head"], slot["head_vc"], ss, rr, vcs
+            )
+            launches.append((tname_t, items, resolved, fresh))
+            if m is not None:
+                m.serving_reads.inc(mcount, path="gather")
+        return _EpochReadPending(ep, objects, vals, launches), fallback
+
+    def epoch_read_finish(self, pending: "_EpochReadPending") -> List[Any]:
+        """Materialize + decode a launched epoch read batch (the ONLY
+        stage allowed to block on the device) and back-fill the snapshot
+        cache.  Returns the decoded values in object order (entries for
+        objects the caller rerouted stay None)."""
+        from antidote_tpu.crdt.base import RESOLVE_OVERFLOW
+
+        ep = pending.ep
+        vals = pending.vals
+        for tname_t, items, resolved, fresh in pending.launches:
+            t = self.table(tname_t)
+            ty = t.ty
+            host = {f: np.asarray(x) for f, x in resolved.items()}
+            del fresh  # provably all-fresh: frozen head_vc ≤ cap ≤ E
+            has_resolve = ty.resolve_spec(t.cfg) is not None
+            slot = ep.tables[tname_t]
+            for j, (i, shard, row) in enumerate(items):
+                view = {f: x[j] for f, x in host.items()}
+                if has_resolve:
+                    v = ty.value_from_resolved(view, self.blobs, t.cfg)
+                    if v is RESOLVE_OVERFLOW:
+                        # truncated top-count view: re-gather the full
+                        # frozen state for this one key (rare)
+                        full = {
+                            f: np.asarray(x[shard, row])
+                            for f, x in slot["head"].items()
+                        }
+                        v = ty.value(full, self.blobs, t.cfg)
+                else:
+                    v = ty.value(view, self.blobs, t.cfg)
+                vals[i] = v
+                key, _tn, bucket = pending.objects[i]
+                self.snapshot_cache_fill((key, bucket), ep,
+                                         (tname_t, shard, row), v)
+        return vals
+
+    # ------------------------------------------------------------------
     # decoded-value cache (serving hot path)
     # ------------------------------------------------------------------
     def value_cache_get(self, key, bucket, read_vc_tuple):
@@ -593,10 +1027,28 @@ class KVStore:
                    out=t_new.max_commit_vc)
         t_old.n_ops[shard, row] = 0
         t_old.slots_ub[shard, row] = 0
-        # both tables mutated outside the append path: frozen epoch copies
-        # would serve the pre-promotion (old table) / bottom (new table) row
-        t_old.invalidate_epochs()
-        t_new.invalidate_epochs()
+        # both tables mutated outside the append path: the LADDER's
+        # frozen epoch copies would serve the pre-promotion (old table) /
+        # bottom (new table) row — drop them.  The SERVING double buffer
+        # survives: the move touches exactly two rows, both marked dirty
+        # below (re-frozen at the next publish), and the promoted mark
+        # makes epoch readers fall back for this key meanwhile — a
+        # promotion no longer costs a whole-store epoch invalidation
+        # (which forced full-table copy republishes, a Zipf-workload
+        # serving-latency cliff).
+        t_old.epochs.clear()
+        t_new.epochs.clear()
+        t_old.note_serving_touch(np.asarray([shard]), np.asarray([row]))
+        t_new.note_serving_touch(np.asarray([shard]), np.asarray([new_row]))
+        # mark the key promoted on every live epoch BEFORE the directory
+        # flips: a lock-free epoch reader that sees the new entry also
+        # sees the promoted mark and falls back (GIL-ordered)
+        with self._epoch_lock:
+            eps = list(self._epoch_graveyard)
+            if self.serving_epoch is not None:
+                eps.append(self.serving_epoch)
+        for e in eps:
+            e.promoted.add(dk)
         self.directory[dk] = (tiered_name(base, new_tier), shard, new_row)
         self.promotions += 1
 

@@ -262,6 +262,143 @@ class TypedTable:
         #: is pointless while every read is provably fresh — publishers
         #: key off this)
         self.slow_serves = 0
+        # --- serving-epoch double buffer (ISSUE 5 lock-split reads) ----
+        # Two alternating frozen (head, head_vc) snapshots that the wire
+        # server's lock-free read stage gathers from.  Unlike ``epochs``
+        # (whole-head jnp.copy per publish), these are maintained
+        # INCREMENTALLY: the publish scatters only the rows appended
+        # since the spare buffer's freeze into the DONATED spare — so
+        # publish cost scales with the write working set, not table size
+        # (the satellite "bound publish_epoch cost per tick").
+        self._serving = [None, None]
+        self._serving_cur = 0
+        #: (shard, row) pairs appended since the current / spare slot's
+        #: freeze; None = unbounded (overflow or invalidation) — the next
+        #: freeze must full-copy
+        self._serving_dirty: "set | None" = set()
+        self._serving_spare_dirty: "set | None" = None
+        #: called (no args) whenever an out-of-band mutation invalidates
+        #: the frozen buffers — the KVStore points this at its
+        #: serving-epoch drop so stale store-wide epochs die with them
+        self.on_serving_invalidate = None
+        self._serving_conservative = False
+        self._freeze_scatter_fns: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    # serving-epoch double buffer (lock-free wire reads)
+    # ------------------------------------------------------------------
+    #: dirty sets past this size stop tracking rows; the next freeze
+    #: full-copies (a scatter of 10k+ rows stops beating the copy)
+    _SERVING_DIRTY_CAP = 8192
+
+    def note_serving_touch(self, shards, rows) -> None:
+        """Record appended rows for the incremental serving freeze."""
+        pairs = list(zip(shards.tolist(), rows.tolist()))
+        for attr in ("_serving_dirty", "_serving_spare_dirty"):
+            s = getattr(self, attr)
+            if s is None:
+                continue
+            s.update(pairs)
+            if len(s) > self._SERVING_DIRTY_CAP:
+                setattr(self, attr, None)
+
+    def serving_slot(self):
+        """The current frozen serving buffer (or None before any freeze)."""
+        return self._serving[self._serving_cur]
+
+    def serving_spare(self):
+        """The slot the NEXT freeze would donate — publishers check it
+        against the live epoch's buffers (donating a buffer the current
+        epoch still gathers from would delete it under a reader)."""
+        return self._serving[1 - self._serving_cur]
+
+    def serving_dirty(self) -> bool:
+        cur = self._serving[self._serving_cur]
+        return cur is None or self._serving_dirty is None or bool(
+            self._serving_dirty)
+
+    def invalidate_serving(self) -> None:
+        """Drop both frozen buffers after any out-of-band table mutation
+        (row growth, handoff install)."""
+        self._serving = [None, None]
+        self._serving_dirty = set()
+        self._serving_spare_dirty = None
+        #: the out-of-band mutation isn't row-tracked: the next freeze
+        #: must report its write-set as UNKNOWN (touched=None) so cache
+        #: entries cannot revalidate across it
+        self._serving_conservative = True
+        cb = self.on_serving_invalidate
+        if cb is not None:
+            cb()
+
+    def _freeze_scatter_for(self, bucket: int):
+        """Jitted incremental freeze: donate the spare buffer, scatter
+        the dirty rows' live head state over it.  One compile per
+        padded-batch bucket."""
+        fn = self._freeze_scatter_fns.get(bucket)
+        if fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def fn(sp_head, sp_vc, head, head_vc, ss, rr):
+                out = {
+                    f: x.at[ss, rr].set(head[f][ss, rr], mode="drop")
+                    for f, x in sp_head.items()
+                }
+                return out, sp_vc.at[ss, rr].set(head_vc[ss, rr],
+                                                 mode="drop")
+
+            self._freeze_scatter_fns[bucket] = fn
+        return fn
+
+    def freeze_serving(self, can_donate: bool, force_copy: bool = False):
+        """Freeze the live head into the spare serving slot and make it
+        current.  Returns (slot, mode, touched, rows): mode "scatter"
+        (incremental — ``rows`` rows re-frozen) or "copy" (full).
+        ``touched`` is the frozenset of rows WRITTEN since the previous
+        publish (one window — the snapshot cache's validity set; the
+        scatter set itself spans two windows, one per buffer slot), or
+        None when unknown (untracked overflow / after an out-of-band
+        invalidation).  Returns None when the freeze must be DEFERRED
+        (the spare may still be read by a pinned epoch and cannot be
+        donated).  ``force_copy`` rebuilds the slot from scratch instead
+        of donating — required when the spare is still referenced by the
+        LIVE epoch (a partial publish left it there; waiting can never
+        free it).
+
+        Caller must hold the commit lock (no concurrent appends)."""
+        spare_i = 1 - self._serving_cur
+        spare = self._serving[spare_i]
+        dirty = self._serving_spare_dirty
+        if force_copy or spare is None or dirty is None:
+            frozen = self._copy_tree_fn((self.head, self.head_vc))
+            mode, rows = "copy", self.n_shards * self.n_rows
+        elif not can_donate:
+            return None
+        else:
+            pairs = sorted(dirty)
+            m = len(pairs)
+            mb = _bucket(max(m, 1), self.cfg.batch_buckets)
+            ss = np.full(mb, self.n_shards, np.int64)
+            rr = np.zeros(mb, np.int64)
+            ss[:m] = [p[0] for p in pairs]
+            rr[:m] = [p[1] for p in pairs]
+            # padding uses shard index P (out of range): the scatter
+            # drops it, and the matching gather clips harmlessly
+            fn = self._freeze_scatter_for(mb)
+            frozen = fn(spare["head"], spare["head_vc"],
+                        self.head, self.head_vc, ss, rr)
+            mode, rows = "scatter", m
+        slot = {"head": frozen[0], "head_vc": frozen[1],
+                "cap": self.max_commit_vc.copy()}
+        if self._serving_conservative or self._serving_dirty is None:
+            touched = None
+            self._serving_conservative = False
+        else:
+            touched = frozenset(self._serving_dirty)
+        self._serving[spare_i] = slot
+        self._serving_cur = spare_i
+        self._serving_spare_dirty = self._serving_dirty
+        self._serving_dirty = set()
+        return slot, mode, touched, rows
 
     # ------------------------------------------------------------------
     # row allocation / growth
@@ -340,6 +477,7 @@ class TypedTable:
         """Drop every published epoch — required after any out-of-band
         table mutation (row growth, key promotion, handoff install)."""
         self.epochs.clear()
+        self.invalidate_serving()
 
     def _epoch_for(self, read_vcs: np.ndarray):
         """Oldest epoch whose cap dominates every read VC in the batch
@@ -841,6 +979,7 @@ class TypedTable:
             row_mat, start_mat, end_mat,
         )
         np.add.at(self.n_ops, (shards, rows), 1)
+        self.note_serving_touch(us_s, ur_s)
 
     def gc(self, shards, rows):
         """Fold the given keys' rings into a fresh snapshot version."""

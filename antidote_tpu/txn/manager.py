@@ -174,6 +174,45 @@ class TransactionManager:
         #: NodeMetrics — the coordinator's counter bumps
         #: (/root/reference/src/clocksi_interactive_coord.erl:667,734,849-870)
         self.metrics = None
+        #: serving-epoch publication (ISSUE 5): when enabled (by the wire
+        #: server), every write-bearing commit group and remote-ingress
+        #: apply publishes a fresh store-wide serving snapshot before it
+        #: acks, so the server's lock-free read stage serves at a clock
+        #: that covers everything the client was told is committed
+        self.serving_epochs = False
+        #: highest own-lane commit counter that was ACKED while its
+        #: publish deferred/failed — the wire server's clockless reads
+        #: may serve from an epoch only when it covers this floor
+        #: (write-then-read freshness survives deferred publishes; 0 =
+        #: every ack so far went out under a covering epoch)
+        self.epoch_lag_counter = 0
+
+    # ------------------------------------------------------------------
+    # serving-epoch publication (lock-split wire reads)
+    # ------------------------------------------------------------------
+    def enable_serving_epochs(self) -> None:
+        # clocksi-only: gr hands clients SCALARIZED snapshot clocks, and
+        # an epoch's full-vector VC handed back as a gr causal clock
+        # could stall behind the scalar GST forever
+        if self.protocol == "clocksi":
+            self.serving_epochs = True
+
+    def serving_epoch_vc(self) -> np.ndarray:
+        """The publishable snapshot clock E: freshest applied lanes with
+        the own lane raised to the commit counter.  Caller must hold the
+        commit lock (E must be captured with no apply in flight)."""
+        vc = self.store.dc_max_vc().copy()
+        vc[self.my_dc] = max(int(vc[self.my_dc]), self.commit_counter)
+        return vc
+
+    def publish_serving_epoch(self) -> str:
+        """Ticker-driven publication: take the commit lock and publish
+        (no-ops when the current epoch already covers the store)."""
+        with self.commit_lock:
+            return self._publish_serving_epoch_locked()
+
+    def _publish_serving_epoch_locked(self) -> str:
+        return self.store.publish_serving_epoch(self.serving_epoch_vc())
 
     # ------------------------------------------------------------------
     # transaction lifecycle (antidote.erl API shapes)
@@ -561,7 +600,24 @@ class TransactionManager:
                         self.check_writable()
                     t0 = time.monotonic()
                     try:
-                        return self._commit_group_locked(txns)
+                        out = self._commit_group_locked(txns)
+                        if has_writes and self.serving_epochs:
+                            # publish BEFORE the ack leaves: a clockless
+                            # read admitted after this commit's reply must
+                            # find an epoch that covers it (read-your-
+                            # writes stays intact under the lock split).
+                            # A deferred/failed publish raises the lag
+                            # floor instead — epoch reads below it fall
+                            # back to the (always-fresh) locked path.
+                            try:
+                                st = self._publish_serving_epoch_locked()
+                            except Exception:
+                                st = "error"
+                                log.exception(
+                                    "serving-epoch publish failed")
+                            if st not in ("published", "noop"):
+                                self.epoch_lag_counter = self.commit_counter
+                        return out
                     except OSError as e:
                         if has_writes and e.errno in (errno.ENOSPC,
                                                       errno.EIO,
@@ -778,6 +834,17 @@ class TransactionManager:
         self.store.apply_effects(
             effects, [commit_vc] * len(effects), [origin] * len(effects)
         )
+        if self.serving_epochs:
+            # keep the lock-free read plane's snapshot moving with
+            # replication (callers already hold the reentrant commit lock)
+            with self.commit_lock:
+                try:
+                    self._publish_serving_epoch_locked()
+                except Exception:
+                    log.exception("serving-epoch publish failed")
+                # no lag-floor bump here: remote effects were never acked
+                # to a local client, so clockless reads owe them nothing
+                # (the ticker's retry publishes them within a tick)
 
     # ------------------------------------------------------------------
     def _read_states_with_overlay(self, objects, txn):

@@ -1,0 +1,405 @@
+"""ISSUE 5 serving-pipeline tests: lock-split epoch reads, the hot-key
+snapshot cache, bounded publication cost, and the staged wire server.
+
+The load-bearing properties:
+
+  * epoch-pinned static reads execute OUTSIDE the server/commit locks —
+    a held commit lock (a stalled commit group, a publication tick) can
+    no longer stall a parked read batch;
+  * every read returns a published-epoch-consistent snapshot: a commit
+    group is never split across an epoch boundary (no torn reads), and
+    a read admitted after a write's ack sees that write (no
+    stale-past-epoch values);
+  * the snapshot cache invalidates on epoch advance for written rows
+    and revalidates across arbitrarily many unrelated publishes;
+  * publication cost scales with rows written since the last publish
+    (never table size) and is capped per tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.proto.client import AntidoteClient
+from antidote_tpu.proto.server import ProtocolServer
+
+pytestmark = pytest.mark.smoke
+
+
+def _mk(**kw):
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=256, **kw)
+    node = AntidoteNode(cfg)
+    srv = ProtocolServer(node, port=0, epoch_tick_ms=25)
+    return node, srv
+
+
+# ---------------------------------------------------------------------------
+# lock-split: reads never park behind the commit/server locks
+# ---------------------------------------------------------------------------
+def test_epoch_reads_not_stalled_by_held_commit_lock():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port, timeout=30)
+    try:
+        c.update_objects([("hot", "counter_pn", "b", ("increment", 7))])
+        c.update_objects([("cold", "counter_pn", "b", ("increment", 3))])
+        c.read_objects([("hot", "counter_pn", "b")])  # prime the cache
+        assert node.store.serving_epoch is not None
+        # wedge BOTH locks the old path parked behind: a publication
+        # tick / commit group in progress must not stall epoch reads
+        with node.txm.commit_lock, srv._lock:
+            c2 = AntidoteClient(srv.host, srv.port, timeout=5)
+            t0 = time.monotonic()
+            vals, _ = c2.read_objects([("hot", "counter_pn", "b")])
+            assert vals == [7]  # cache plane
+            vals, _ = c2.read_objects([("cold", "counter_pn", "b")])
+            assert vals == [3]  # gather plane (first read of this key)
+            elapsed = time.monotonic() - t0
+            c2.close()
+        assert elapsed < 4.0, f"reads stalled {elapsed:.1f}s behind locks"
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# read/write concurrency: epoch-consistent snapshots, no torn reads
+# ---------------------------------------------------------------------------
+def test_concurrent_commits_and_epoch_reads_see_consistent_snapshots():
+    node, srv = _mk()
+    stop = time.monotonic() + 3.0
+    errors: list = []
+    pair = [("a", "counter_pn", "b"), ("b", "counter_pn", "b")]
+
+    def writer():
+        try:
+            c = AntidoteClient(srv.host, srv.port)
+            while time.monotonic() < stop:
+                # ONE txn bumps both keys: any epoch-consistent snapshot
+                # shows them EQUAL — a mismatch is a torn read
+                c.update_objects([
+                    ("a", "counter_pn", "b", ("increment", 1)),
+                    ("b", "counter_pn", "b", ("increment", 1)),
+                ])
+            c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(repr(e))
+
+    def reader():
+        try:
+            c = AntidoteClient(srv.host, srv.port)
+            last_v = -1
+            last_vc = None
+            while time.monotonic() < stop:
+                vals, vc = c.read_objects(pair)
+                if vals[0] != vals[1]:
+                    errors.append(f"torn read: {vals}")
+                    break
+                if vals[0] < last_v:
+                    errors.append(f"snapshot went backwards: {vals[0]} "
+                                  f"< {last_v}")
+                    break
+                if last_vc is not None and any(
+                        n < o for n, o in zip(vc, last_vc)):
+                    errors.append(f"clock went backwards: {vc} < {last_vc}")
+                    break
+                last_v, last_vc = vals[0], vc
+            c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(repr(e))
+
+    ts = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(3)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    srv.close()
+    assert not errors, errors
+    # the epoch plane actually served (not everything fell to locked)
+    m = node.metrics
+    assert (m.serving_reads.value(path="cache")
+            + m.serving_reads.value(path="gather")) > 0
+
+
+def test_write_then_clockless_read_sees_the_write():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        for i in range(1, 40):
+            c.update_objects([("rw", "counter_pn", "b", ("increment", 1))])
+            vals, _ = c.read_objects([("rw", "counter_pn", "b")])
+            assert vals == [i], (i, vals)
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot cache correctness
+# ---------------------------------------------------------------------------
+def test_cache_hit_after_epoch_advance_on_written_key_misses():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        c.update_objects([("k", "set_aw", "b", ("add", 1))])
+        vals, _ = c.read_objects([("k", "set_aw", "b")])
+        assert vals[0] == [1]
+        m = node.metrics
+        hits0 = m.snapshot_cache.value(event="hit")
+        # same-epoch re-read: a hit
+        vals, _ = c.read_objects([("k", "set_aw", "b")])
+        assert vals[0] == [1]
+        assert m.snapshot_cache.value(event="hit") == hits0 + 1
+        # the write advances the epoch and re-freezes k's row: the
+        # cached entry MUST miss (serving it would lose the new element)
+        c.update_objects([("k", "set_aw", "b", ("add", 2))])
+        hits1 = m.snapshot_cache.value(event="hit")
+        vals, _ = c.read_objects([("k", "set_aw", "b")])
+        assert sorted(vals[0]) == [1, 2]
+        assert m.snapshot_cache.value(event="hit") == hits1
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_cache_revalidates_across_unrelated_epoch_advances():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        # two priming writes first: the double buffer's first TWO
+        # publishes are whole-table copies (both slots must exist), and
+        # a copy in the history chain correctly blocks revalidation
+        c.update_objects([("warm0", "set_aw", "b", ("add", 1))])
+        c.update_objects([("warm1", "set_aw", "b", ("add", 1))])
+        c.update_objects([("stable", "set_aw", "b", ("add", 9))])
+        vals, _ = c.read_objects([("stable", "set_aw", "b")])
+        assert vals[0] == [9]
+        ep0 = node.store.serving_epoch.id
+        # many unrelated writes advance the epoch many times
+        for i in range(10):
+            c.update_objects([(f"other{i}", "set_aw", "b", ("add", i))])
+        assert node.store.serving_epoch.id > ep0
+        m = node.metrics
+        hits0 = m.snapshot_cache.value(event="hit")
+        vals, _ = c.read_objects([("stable", "set_aw", "b")])
+        assert vals[0] == [9]
+        assert m.snapshot_cache.value(event="hit") == hits0 + 1, (
+            "untouched key failed to revalidate across unrelated epochs")
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# publication cost: scales with writes, capped, never stalls readers
+# ---------------------------------------------------------------------------
+def test_publish_cost_scales_with_rows_written_not_table_size():
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=512)
+    node = AntidoteNode(cfg)
+    txm = node.txm
+    store = node.store
+    m = node.metrics
+    # seed + the first two publishes are whole-table copies (both slots
+    # of the double buffer must exist before incremental freezes begin)
+    node.update_objects([("seed", "counter_pn", "b", ("increment", 1))])
+    assert store.publish_serving_epoch(txm.serving_epoch_vc()) == "published"
+    node.update_objects([("seed", "counter_pn", "b", ("increment", 1))])
+    assert store.publish_serving_epoch(txm.serving_epoch_vc()) == "published"
+    assert m.epoch_publish.value(mode="copy") == 2
+    # k rows written => the next publish scatters the rows written
+    # since the SPARE slot's freeze (two publish windows: the one seed
+    # row from before the second copy, plus the k fresh rows) —
+    # independent of the table's 4*512 row capacity
+    k = 7
+    node.update_objects([
+        (f"k{i}", "counter_pn", "b", ("increment", 1)) for i in range(k)
+    ])
+    rows0 = m.epoch_rows.value(mode="scatter")
+    assert store.publish_serving_epoch(txm.serving_epoch_vc()) == "published"
+    assert m.epoch_rows.value(mode="scatter") - rows0 == k + 1
+    assert m.epoch_publish.value(mode="copy") == 2  # still no full copy
+    # noop when nothing changed
+    assert store.publish_serving_epoch(txm.serving_epoch_vc()) == "noop"
+    # past the dirty cap the freeze degrades to an EXPLICIT full copy
+    # (a 10k-row scatter stops beating the copy) — the cost cap is
+    # visible in the mode counters either way
+    t = store.table("counter_pn")
+    t._SERVING_DIRTY_CAP = 4
+    node.update_objects([
+        (f"w{i}", "counter_pn", "b", ("increment", 1)) for i in range(6)
+    ])
+    assert store.publish_serving_epoch(txm.serving_epoch_vc()) == "published"
+    assert m.epoch_publish.value(mode="copy") == 3
+
+
+def test_table_epoch_ladder_budget_one_per_tick():
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=256)
+    node = AntidoteNode(cfg)
+    srv = ProtocolServer(node, port=0, epoch_tick_ms=0)
+    # stop the ticker (it drives the ladder even with the epoch plane
+    # off) so the budgeted calls below can't race it
+    srv._ticker_stop.set()
+    srv._ticker.join(timeout=5)
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        store = node.store
+        # two dirty tables, both eligible for a ladder publish
+        c.update_objects([("x", "counter_pn", "b", ("increment", 1))])
+        c.update_objects([("y", "set_aw", "b", ("add", 1))])
+        for t in store.tables.values():
+            t.slow_serves += 1
+            t._pub_at = 0.0
+            if hasattr(t, "_pub_slow_serves"):
+                del t._pub_slow_serves
+        n_tables = len(store.tables)
+        assert n_tables >= 2
+        # each tick publishes AT MOST one table's full-head epoch copy
+        assert srv._publish_table_epochs_capped() == 1
+        assert srv._publish_table_epochs_capped() == 1
+        assert sum(
+            1 for t in store.tables.values() if t.epochs
+        ) == 2
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch ticker: publication without static-batch traffic
+# ---------------------------------------------------------------------------
+def test_ticker_publishes_without_any_static_traffic():
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=256)
+    node = AntidoteNode(cfg)
+    # data lands BEFORE the server exists (no publish hooks active)
+    node.update_objects([("pre", "counter_pn", "b", ("increment", 5))])
+    assert node.store.serving_epoch is None
+    srv = ProtocolServer(node, port=0, epoch_tick_ms=25)
+    try:
+        deadline = time.monotonic() + 5.0
+        while node.store.serving_epoch is None:
+            assert time.monotonic() < deadline, (
+                "ticker never published an epoch")
+            time.sleep(0.05)
+        assert int(node.store.serving_epoch.vc[0]) >= 1
+    finally:
+        srv.close()
+
+
+def test_epoch_tick_zero_disables_the_epoch_plane():
+    cfg = AntidoteConfig(n_shards=4, max_dcs=2, keys_per_table=256)
+    node = AntidoteNode(cfg)
+    srv = ProtocolServer(node, port=0, epoch_tick_ms=0)
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        assert not srv._epoch_reads
+        c.update_objects([("k", "counter_pn", "b", ("increment", 2))])
+        vals, _ = c.read_objects([("k", "counter_pn", "b")])
+        assert vals == [2]
+        assert node.store.serving_epoch is None
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# promotion: the serving epoch survives a tier crossing
+# ---------------------------------------------------------------------------
+def test_promotion_keeps_serving_epoch_and_reads_stay_exact():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        store = node.store
+        cap = store.cfg.set_slots
+        # grow one set key across at least one slot-tier boundary while
+        # reading it back between writes
+        n = cap * 3
+        for i in range(n):
+            c.update_objects([("grow", "set_aw", "b", ("add", i))])
+            if i % 7 == 0:
+                vals, _ = c.read_objects([("grow", "set_aw", "b")])
+                assert sorted(vals[0]) == list(range(i + 1))
+        assert store.promotions >= 1
+        # the fix under test: a promotion no longer nukes the serving
+        # epoch (no whole-table copy republish storm)
+        assert store.serving_epoch is not None
+        vals, _ = c.read_objects([("grow", "set_aw", "b")])
+        assert sorted(vals[0]) == list(range(n))
+        # reads of OTHER keys kept their cache/gather plane alive
+        c.update_objects([("bystander", "set_aw", "b", ("add", 1))])
+        vals, _ = c.read_objects([("bystander", "set_aw", "b")])
+        assert vals[0] == [1]
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# clocked reads against the epoch plane
+# ---------------------------------------------------------------------------
+def test_clocked_read_at_returned_epoch_clock():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        c.update_objects([("ck", "counter_pn", "b", ("increment", 4))])
+        vals, vc = c.read_objects([("ck", "counter_pn", "b")])
+        assert vals == [4]
+        # hand the epoch clock back as the causal clock: still served,
+        # still exact (covered => epoch-eligible)
+        vals2, vc2 = c.read_objects([("ck", "counter_pn", "b")], clock=vc)
+        assert vals2 == [4]
+        assert all(b >= a for a, b in zip(vc, vc2))
+        # a clock AHEAD of the epoch falls back to the locked path
+        ahead = list(vc)
+        ahead[0] += 1
+        c.update_objects([("ck", "counter_pn", "b", ("increment", 1))])
+        vals3, _ = c.read_objects([("ck", "counter_pn", "b")], clock=ahead)
+        assert vals3 == [5]
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_wrong_type_read_raises_even_when_cached():
+    """Cache residency must never change observable behavior: a read of
+    a key under the WRONG CRDT type raises the same TypeError whether
+    the key's value sits in the snapshot cache or not."""
+    from antidote_tpu.proto.client import RemoteError
+
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        c.update_objects([("typed", "counter_pn", "b", ("increment", 3))])
+        vals, _ = c.read_objects([("typed", "counter_pn", "b")])
+        assert vals == [3]  # cached now
+        with pytest.raises(RemoteError, match="bound"):
+            c.read_objects([("typed", "set_aw", "b")])
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_pipeline_status_block_exposed():
+    node, srv = _mk()
+    c = AntidoteClient(srv.host, srv.port)
+    try:
+        c.update_objects([("s", "counter_pn", "b", ("increment", 1))])
+        c.read_objects([("s", "counter_pn", "b")])
+        st = c.node_status()
+        pl = st["pipeline"]
+        assert pl["epoch_reads"] is True
+        assert set(pl["stages"]) == {"decode", "parked", "launch",
+                                     "writeback"}
+        for s in pl["stages"].values():
+            assert {"count", "sum_ms", "mean_us", "p50_us",
+                    "p99_us"} <= set(s)
+        assert pl["serving_epoch_id"] >= 1
+        assert "hit" in pl["snapshot_cache"] or pl["snapshot_cache"]
+    finally:
+        c.close()
+        srv.close()

@@ -112,6 +112,7 @@ def check_file(path: str):
                 problems.append(f"{path}:{node.lineno}: bare 'except:'")
     _check_swallow_loops(tree, path, noqa, problems)
     _check_unbounded_queues(tree, path, lines, problems)
+    _check_serving_syncs(path, lines, problems)
     return problems
 
 
@@ -170,6 +171,40 @@ def _check_unbounded_queues(tree, path, lines, problems) -> None:
                 f"overload-protected plane: {bad} — give it an explicit "
                 "bound or justify with '# bounded-by: <reason>' above"
             )
+
+
+#: files that ARE the wire-serving hot path: a device sync on a
+#: dispatcher-stage thread stalls every parked request behind one
+#: materialize (the staged pipeline confines syncs to the writeback
+#: stage) — ISSUE 5 discipline, mirroring the unbounded-queue rule
+_SERVING_HOT_PATH = (os.path.join("antidote_tpu", "proto", "server.py"),)
+_SYNC_TOKENS = ("block_until_ready(", ".item()", "np.asarray(")
+
+
+def _check_serving_syncs(path, lines, problems) -> None:
+    """In the serving hot path, flag device-sync idioms —
+    ``block_until_ready(``, ``.item()``, ``np.asarray(`` — unless a
+    ``# sync-ok: <reason>`` annotation on the line or within the three
+    preceding lines justifies it (e.g. the writeback stage, which owns
+    the sync, or a conversion of host data that never touches a jax
+    array)."""
+    norm = os.path.normpath(path)
+    if not any(norm.endswith(p) for p in _SERVING_HOT_PATH):
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("sync-ok:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        for tok in _SYNC_TOKENS:
+            if tok in code and not annotated(i) and "sync-ok:" not in ln:
+                problems.append(
+                    f"{path}:{i}: device-sync idiom '{tok}' in the "
+                    "serving hot path — move it to the writeback stage "
+                    "or justify with '# sync-ok: <reason>'"
+                )
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
