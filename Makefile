@@ -32,9 +32,14 @@ saturation:
 # serving-pipeline smoke (ISSUE 5): ~30s read-only north-star wire run;
 # fails when read throughput drops below 0.8x the frozen perf_smoke
 # entry in BENCH_WIRE_cpu.json — the CI tripwire for the lock-split
-# epoch-read plane (runs alongside `make saturation` in CI)
+# epoch-read plane (runs alongside `make saturation` in CI).
+# The ISSUE 6 write-plane twin rides the same target: ~30s write-heavy
+# run gated at 0.8x the frozen perf_smoke_write entry (cross-connection
+# group commit + parallel WAL + certification bypass tripwire).
+# Neither gate ever ratchets its floor.
 perf-smoke:
 	$(PY) bench_wire.py --perf-smoke --assert-bounds --json BENCH_WIRE_cpu.json
+	$(PY) bench_wire.py --perf-smoke-write --assert-bounds --json BENCH_WIRE_cpu.json
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

@@ -106,6 +106,10 @@ class AntidoteNode:
         self.txm.metrics = self.metrics
         # snapshot-cache / serving-epoch counters land in the same registry
         self.store.metrics = self.metrics
+        if self.store.log is not None:
+            # group-fsync coordinator -> antidote_wal_fsync_batch
+            self.store.log.on_fsync_batch = (
+                self.metrics.wal_fsync_batch.observe)
         # count this package's ERROR-level log records (antidote_error_monitor)
         self._error_handler = install_error_monitor(
             self.metrics, logging.getLogger("antidote_tpu")
@@ -219,6 +223,25 @@ class AntidoteNode:
             "commit_backlog": self.txm._commit_backlog,
             "max_commit_backlog": self.txm.max_commit_backlog,
             "shed": shed,
+        }
+        # write plane (ISSUE 6): merge width, group-fsync batching,
+        # per-segment durability debt, bypass counts — the knobs table
+        # in docs/operations.md explains how to read these
+        def _hist(h):
+            s = h.summary()
+            return {"count": s["count"], "mean": round(s["mean"], 2),
+                    "p50": s["p50"], "p99": s["p99"]}
+
+        wlog = self.store.log
+        out["write_plane"] = {
+            "merge_width": _hist(self.metrics.commit_merge_width),
+            "fsync_batch": _hist(self.metrics.wal_fsync_batch),
+            "cert_bypass_total": int(self.metrics.cert_bypass.value()),
+            "sync_log": (bool(wlog.wals[0].sync_on_commit)
+                         if wlog is not None else None),
+            "wal_segments": wlog.n_segments if wlog is not None else 0,
+            "segment_depth_bytes": (wlog.segment_depths()
+                                    if wlog is not None else []),
         }
         if include_ready:
             out["ready"] = self.check_ready()

@@ -51,6 +51,14 @@ class AntidoteConfig:
     # --- durability (reference: antidote.app.src:44-48) ---------------
     enable_logging: bool = True
     sync_log: bool = False
+    #: parallel append segments per shard WAL (ISSUE 6): a commit group's
+    #: records land on one segment while the group-fsync coordinator
+    #: syncs the previous one in the background, so the serial
+    #: append+fsync floor splits across segments.  1 = the classic
+    #: single-file-per-shard layout (and byte-identical file contents);
+    #: recovery merges segments by the per-shard append sequence either
+    #: way.  Serving entrypoints (console serve) default higher.
+    wal_segments: int = 1
 
     # --- kernels --------------------------------------------------------
     #: dispatch the materializer hot loops to the hand-tiled Pallas TPU

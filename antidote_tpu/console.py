@@ -70,7 +70,9 @@ def cmd_serve(args) -> int:
     shards, max_dcs = resolve_serve_shape(args.log_dir, args.shards,
                                           args.max_dcs)
     cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs,
-                         keys_per_table=args.keys_per_table)
+                         keys_per_table=args.keys_per_table,
+                         wal_segments=args.wal_segments,
+                         sync_log=args.sync_log)
     has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and any(
         f.endswith(".wal") and os.path.getsize(os.path.join(args.log_dir, f)) > 0
         for f in os.listdir(args.log_dir)
@@ -135,6 +137,7 @@ def cmd_serve(args) -> int:
             default_deadline_ms=args.default_deadline_ms,
             epoch_tick_ms=args.epoch_tick_ms,
             snapshot_cache_size=args.snapshot_cache_size,
+            group_commit_window_us=args.group_commit_window_us,
         )
         return server_box["srv"]
 
@@ -208,26 +211,40 @@ def cmd_update(args) -> int:
 
 
 def cmd_inspect(args) -> int:
-    """Offline WAL inspection (log_recovery debugging aid)."""
+    """Offline WAL inspection (log_recovery debugging aid).  Segment
+    files (``shard_P.sN.wal``) merge into their shard's summary in
+    replay order, exactly as recovery would read them."""
     import glob
     import os
+    import re
 
-    from antidote_tpu.log.wal import replay
+    from antidote_tpu.log import shard_segment_paths
+    from antidote_tpu.log.wal import replay_segments
 
+    shards = sorted({
+        int(m.group(1))
+        for p in glob.glob(os.path.join(args.log_dir, "shard_*.wal"))
+        if (m := re.match(r"shard_(\d+)(\.s\d+)?\.wal$",
+                          os.path.basename(p)))
+    })
     out = {}
-    for path in sorted(glob.glob(os.path.join(args.log_dir, "shard_*.wal"))):
-        shard = os.path.basename(path)
-        recs = keys = 0
+    for shard in shards:
+        paths = [p for p in shard_segment_paths(args.log_dir, shard)
+                 if os.path.exists(p)]
+        recs = 0
         chains: dict = {}
         types: dict = {}
-        for rec in replay(path):
+        for rec in replay_segments(paths):
             recs += 1
             o = int(rec["o"])
             chains[o] = max(chains.get(o, 0), int(rec["id"]))
             types[rec["t"]] = types.get(rec["t"], 0) + 1
-        out[shard] = {"records": recs, "opid_chains": chains,
-                      "records_by_type": types,
-                      "bytes": os.path.getsize(path)}
+        out[f"shard_{shard}"] = {
+            "records": recs, "opid_chains": chains,
+            "records_by_type": types,
+            "segments": len(paths),
+            "bytes": sum(os.path.getsize(p) for p in paths),
+        }
     print(json.dumps(out, indent=2))
     return 0
 
@@ -362,6 +379,24 @@ def main(argv=None) -> int:
     sv.add_argument("--snapshot-cache-size", type=int, default=None,
                     help="hot-key snapshot cache capacity in entries "
                          "(default: the store's built-in 65536)")
+    sv.add_argument("--wal-segments", type=int, default=4,
+                    help="parallel WAL append segments per shard: the "
+                         "group-fsync coordinator syncs one segment "
+                         "while the next commit group appends to its "
+                         "neighbor (1 = classic single-file layout; "
+                         "recovery merges either way)")
+    sv.add_argument("--sync-log", action="store_true",
+                    help="fsync before every commit ack (group fsync: "
+                         "one fdatasync covers the whole merged batch)."
+                         "  Default off, like the reference's "
+                         "sync_log=false — an ack then means 'reached "
+                         "the OS', durable within the WAL's background "
+                         "sync interval")
+    sv.add_argument("--group-commit-window-us", type=float, default=0.0,
+                    help="merge-point gather window in µs: the locked "
+                         "worker keeps draining late-arriving commits "
+                         "this long before taking the commit lock "
+                         "(0 = natural batching only)")
     sv.set_defaults(fn=cmd_serve)
 
     for name, fn in (("status", cmd_status), ("ready", cmd_ready)):

@@ -44,6 +44,15 @@ class CRDTType(abc.ABC):
     #: logs reduce in O(log L) depth and partial folds merge across
     #: devices (materializer/longlog.py; SURVEY §2.10 last row)
     supports_assoc: bool = False
+    #: True for op-based types whose BLIND effects commute (counters,
+    #: sets, flags): an update with no state-dependent downstream from a
+    #: txn that read nothing needs no first-committer-wins round at all
+    #: — concurrent blind updates all apply and converge by CRDT
+    #: construction (the write-plane certification bypass, ISSUE 6; the
+    #: reference's ``certify=false`` analogue made automatic).  Types
+    #: where certification is the SEMANTICS — registers (assign races),
+    #: escrow counters, rga positions, composite maps — stay False.
+    commutative_blind: bool = False
 
     # ---- host side ----------------------------------------------------
 

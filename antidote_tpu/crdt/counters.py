@@ -27,6 +27,7 @@ class CounterPN(CRDTType):
     """
 
     name = "counter_pn"
+    commutative_blind = True
     type_id = 1
     supports_assoc = True
 
@@ -85,6 +86,7 @@ class CounterFat(CRDTType):
     """
 
     name = "counter_fat"
+    commutative_blind = True
     type_id = 2
 
     def eff_a_width(self, cfg):

@@ -60,6 +60,7 @@ class _FlagAssocMixin:
 
 class FlagEW(_FlagAssocMixin, _FlagBase):
     name = "flag_ew"
+    commutative_blind = True
     type_id = 9
 
     def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
@@ -110,6 +111,7 @@ class FlagEW(_FlagAssocMixin, _FlagBase):
 
 class FlagDW(_FlagAssocMixin, _FlagBase):
     name = "flag_dw"
+    commutative_blind = True
     type_id = 10
 
     def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):

@@ -60,6 +60,7 @@ class SetAW(TopCountResolved, CRDTType):
     """
 
     name = "set_aw"
+    commutative_blind = True
     type_id = 6
 
     def eff_b_width(self, cfg):
@@ -206,6 +207,7 @@ class SetRW(TopCountResolved, CRDTType):
     """
 
     name = "set_rw"
+    commutative_blind = True
     type_id = 7
 
     def eff_b_width(self, cfg):
@@ -330,6 +332,7 @@ class SetGO(TopCountResolved, CRDTType):
     """Grow-only set: slots fill monotonically."""
 
     name = "set_go"
+    commutative_blind = True
     type_id = 8
 
     def state_spec(self, cfg):

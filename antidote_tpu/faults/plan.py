@@ -94,6 +94,11 @@ class FaultPlan:
     interdc.rpc         (src_dc, target_dc)            log catch-up + query
     rpc.call            method name                    intra-DC cluster RPC
     wal.append          WAL file basename              durable log
+    wal.fsync           WAL file basename              group-fsync plane
+                                                       (delay stretches the
+                                                       sync window; error/
+                                                       enospc/io_error fail
+                                                       the covering ticket)
     native_pump.load    None                           native receive plane
     ==================  =============================  =================
     """

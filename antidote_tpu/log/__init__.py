@@ -12,17 +12,28 @@ shard's log to rebuild tables, clocks and op-id counters
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
 import re
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+import msgpack
 import numpy as np
 
 from antidote_tpu.config import AntidoteConfig
-from antidote_tpu.log.wal import ShardWAL, replay
+from antidote_tpu.log.wal import (
+    FsyncTicket,
+    GroupFsyncCoordinator,
+    ShardWAL,
+    pack_frames,
+    ready_ticket,
+    replay,
+    replay_segments,
+)
 
-__all__ = ["LogManager", "ShardWAL", "replay"]
+__all__ = ["LogManager", "SegmentedShardWAL", "ShardWAL", "FsyncTicket",
+           "replay", "replay_segments", "shard_segment_paths"]
 
 _META_FILE = "antidote_meta.json"
 
@@ -59,7 +70,8 @@ def _set_dir_meta_key(directory: str, key: str, value) -> None:
     with open(tmp, "w") as f:
         json.dump(meta, f)
         f.flush()
-        os.fsync(f.fileno())
+        os.fsync(f.fileno())  # fsync-ok: dir-meta atomic replace, not a
+        # log append — the group-fsync policy governs record durability
     os.replace(tmp, path)
 
 
@@ -140,37 +152,137 @@ def _validate_dir(cfg: AntidoteConfig, directory: str) -> None:
         json.dump({"n_shards": cfg.n_shards, "max_dcs": cfg.max_dcs,
                    "version": 1}, f)
         f.flush()
-        os.fsync(f.fileno())
+        os.fsync(f.fileno())  # fsync-ok: dir-meta atomic adopt (see above)
     os.replace(tmp, os.path.join(directory, _META_FILE))
+
+
+def shard_segment_paths(directory: str, shard: int,
+                        n_segments: int = 1) -> List[str]:
+    """Every segment file a shard's records may live in: the configured
+    segment set UNION whatever extra ``shard_P.sN.wal`` files exist on
+    disk — a directory written with more segments and opened with fewer
+    must still replay everything."""
+    paths = [os.path.join(directory, f"shard_{shard}.wal")] + [
+        os.path.join(directory, f"shard_{shard}.s{i}.wal")
+        for i in range(1, max(1, n_segments))
+    ]
+    extra = sorted(
+        set(_glob.glob(os.path.join(directory, f"shard_{shard}.s*.wal")))
+        - set(paths)
+    )
+    return paths + extra
+
+
+class SegmentedShardWAL:
+    """One shard's WAL split over N parallel append segments (ISSUE 6).
+
+    Segment 0 keeps the classic ``shard_P.wal`` path (a 1-segment log
+    is byte-compatible with the pre-segmentation layout); segments 1..N
+    live at ``shard_P.sN.wal``.  A commit group's records append to the
+    CURRENT segment; the commit barrier rotates, so the group-fsync
+    coordinator syncs one segment while the next group appends to its
+    neighbor.  Records carry a per-shard append sequence (``"q"``,
+    minted by LogManager) so recovery can merge segments back into
+    exact commit order (:func:`~antidote_tpu.log.wal.replay_segments`)."""
+
+    def __init__(self, directory: str, shard: int, n_segments: int = 1,
+                 sync_on_commit: bool = False):
+        self.shard = shard
+        self.n_segments = max(1, int(n_segments))
+        self.segs = [
+            ShardWAL(p, sync_on_commit=sync_on_commit)
+            for p in shard_segment_paths(directory, shard,
+                                         self.n_segments)[:self.n_segments]
+        ]
+        self._cur = 0
+
+    @property
+    def current(self) -> ShardWAL:
+        return self.segs[self._cur]
+
+    @property
+    def sync_on_commit(self) -> bool:
+        return self.segs[0].sync_on_commit
+
+    def rotate(self) -> None:
+        if self.n_segments > 1:
+            self._cur = (self._cur + 1) % self.n_segments
+
+    # -- single-segment conveniences (tests, handoff) -------------------
+    def append(self, record: dict) -> None:
+        self.current.append(record)
+
+    def tell(self) -> int:
+        return self.current.tell()
+
+    def rollback_to(self, off: int) -> None:
+        self.current.rollback_to(off)
+
+    def set_sync(self, sync: bool) -> None:
+        for s in self.segs:
+            s.set_sync(sync)
+
+    def probe(self) -> None:
+        """Probe EVERY segment file's volume (a per-file fault must keep
+        the node read-only, not flap out via a healthy sibling)."""
+        for s in self.segs:
+            s.probe()
+
+    def commit(self) -> None:
+        for s in self.segs:
+            s.commit()
+
+    def close(self) -> None:
+        for s in self.segs:
+            s.close()
 
 
 class LogManager:
     def __init__(self, cfg: AntidoteConfig, directory: str,
-                 sync_on_commit: Optional[bool] = None):
+                 sync_on_commit: Optional[bool] = None,
+                 segments: Optional[int] = None):
         self.cfg = cfg
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         _validate_dir(cfg, directory)
         sync = cfg.sync_log if sync_on_commit is None else sync_on_commit
+        self.n_segments = max(1, int(
+            getattr(cfg, "wal_segments", 1) if segments is None else segments
+        ))
         self.wals = [
-            ShardWAL(os.path.join(directory, f"shard_{p}.wal"),
-                     sync_on_commit=sync)
+            SegmentedShardWAL(directory, p, self.n_segments,
+                              sync_on_commit=sync)
             for p in range(cfg.n_shards)
         ]
         #: per-(shard, origin) monotone op-id chain
         self.op_ids = np.zeros((cfg.n_shards, cfg.max_dcs), np.int64)
+        #: per-shard append sequence — total order across a shard's
+        #: segments (stamped as ``"q"``; recovery merges by it)
+        self.seqs = np.zeros(cfg.n_shards, np.int64)
         #: blob handles already persisted per shard (avoid re-writing bytes)
         self._blob_seen = [set() for _ in range(cfg.n_shards)]
+        #: group-fsync coordinator: commit barriers under sync_log=true
+        #: submit their dirty segments and wait on the covering ticket
+        self._fsync = GroupFsyncCoordinator(on_batch=self._fsync_batch)
+        #: metrics hook — called with barriers-covered-per-fsync-pass
+        #: (AntidoteNode points it at antidote_wal_fsync_batch.observe)
+        self.on_fsync_batch = None
 
-    def _append_one(self, shard: int, key, type_name: str, bucket: str,
-                    eff_a, eff_b, commit_vc, origin: int,
-                    blob_refs) -> Tuple[int, List[int]]:
-        """Append one record; a failed append rolls the op-id chain and
-        blob-dedup memory back (the WAL itself heals its torn frame), so
-        a refused write never leaves a permanent op-id GAP for egress to
-        publish.  Returns (opid, blob hashes first seen here)."""
+    def _fsync_batch(self, n: int) -> None:
+        cb = self.on_fsync_batch
+        if cb is not None:
+            cb(n)
+
+    def _mint_payload(self, shard: int, key, type_name: str, bucket: str,
+                      eff_a, eff_b, commit_vc, origin: int,
+                      blob_refs) -> Tuple[int, List[int], bytes]:
+        """Mint the next op-id + append sequence and build the packed
+        record payload.  MUTATES op_ids/seqs/_blob_seen — callers must
+        snapshot those for rollback.  Returns (opid, new blob hashes,
+        payload bytes)."""
         self.op_ids[shard, origin] += 1
         opid = int(self.op_ids[shard, origin])
+        self.seqs[shard] += 1
         blobs = [
             (int(h), bytes(data))
             for h, data in blob_refs
@@ -179,32 +291,39 @@ class LogManager:
         new_hashes = [h for h, _ in blobs]
         for h in new_hashes:
             self._blob_seen[shard].add(h)
-        try:
-            self.wals[shard].append({
-                "k": key,
-                "b": bucket,
-                "t": type_name,
-                "a": np.asarray(eff_a, np.int64).tobytes(),
-                "eb": np.asarray(eff_b, np.int32).tobytes(),
-                "vc": [int(x) for x in np.asarray(commit_vc)],
-                "o": int(origin),
-                "id": opid,
-                "bl": blobs,
-            })
-        except BaseException:
-            self.op_ids[shard, origin] -= 1
-            for h in new_hashes:
-                self._blob_seen[shard].discard(h)
-            raise
-        return opid, new_hashes
+        payload = msgpack.packb({
+            "k": key,
+            "b": bucket,
+            "t": type_name,
+            "a": np.asarray(eff_a, np.int64).tobytes(),
+            "eb": np.asarray(eff_b, np.int32).tobytes(),
+            "vc": [int(x) for x in np.asarray(commit_vc)],
+            "o": int(origin),
+            "id": opid,
+            "q": int(self.seqs[shard]),
+            "bl": blobs,
+        }, use_bin_type=True)
+        return opid, new_hashes, payload
 
     def log_effect(self, shard: int, key, type_name: str, bucket: str,
                    eff_a: np.ndarray, eff_b: np.ndarray, commit_vc, origin: int,
                    blob_refs=()) -> int:
         """Append one effect record; returns its op-id in the
-        (shard, origin) chain."""
-        opid, _ = self._append_one(shard, key, type_name, bucket,
-                                   eff_a, eff_b, commit_vc, origin, blob_refs)
+        (shard, origin) chain.  A failed append rolls the op-id chain,
+        append sequence and blob-dedup memory back (the WAL itself heals
+        its torn frame), so a refused write never leaves a permanent
+        op-id GAP for egress to publish."""
+        opid, new_hashes, payload = self._mint_payload(
+            shard, key, type_name, bucket, eff_a, eff_b, commit_vc,
+            origin, blob_refs)
+        try:
+            self.wals[shard].current.append_packed(pack_frames([payload]))
+        except BaseException:
+            self.op_ids[shard, origin] -= 1
+            self.seqs[shard] -= 1
+            for h in new_hashes:
+                self._blob_seen[shard].discard(h)
+            raise
         return opid
 
     def log_effects(self, entries) -> None:
@@ -216,31 +335,77 @@ class LogManager:
         were told failed came back locally (and were never published
         inter-DC, so DCs diverged).
 
+        The group's records reach each touched shard's current segment
+        as ONE pre-framed buffer + ONE write (the measured per-append
+        floor was ctypes/syscall round trips, not bytes).
+
         ``entries``: iterable of ``log_effect`` argument tuples
         ``(shard, key, type_name, bucket, eff_a, eff_b, commit_vc,
         origin, blob_refs)``."""
-        offs: Dict[int, int] = {}
         op_snap = self.op_ids.copy()
+        seq_snap = self.seqs.copy()
         added: List[Tuple[int, int]] = []  # (shard, blob hash) logged
+        per_shard: Dict[int, List[bytes]] = {}
         try:
             for (shard, key, tname, bucket, ea, eb, vc, origin,
                  brefs) in entries:
-                if shard not in offs:
-                    offs[shard] = self.wals[shard].tell()
-                _, new_hashes = self._append_one(
+                _, new_hashes, payload = self._mint_payload(
                     shard, key, tname, bucket, ea, eb, vc, origin, brefs)
                 added.extend((shard, h) for h in new_hashes)
+                per_shard.setdefault(shard, []).append(payload)
+            offs: Dict[int, Tuple[ShardWAL, int]] = {}
+            try:
+                for shard, payloads in per_shard.items():
+                    seg = self.wals[shard].current
+                    offs[shard] = (seg, seg.tell())
+                    seg.append_packed(pack_frames(payloads))
+            except BaseException:
+                for seg, off in offs.values():
+                    try:
+                        seg.rollback_to(off)
+                    except OSError:
+                        pass  # the disk is failing; replay's CRC guard
+                        # still stops at whatever half-frame remains
+                raise
         except BaseException:
-            for s, off in offs.items():
-                try:
-                    self.wals[s].rollback_to(off)
-                except OSError:
-                    pass  # the disk is failing; replay's CRC guard
-                    # still stops at whatever half-frame remains
             self.op_ids[:] = op_snap
+            self.seqs[:] = seq_snap
             for s, h in added:
                 self._blob_seen[s].discard(h)
             raise
+
+    def log_effect_groups(self, groups: Sequence) -> List[Optional[Exception]]:
+        """Log a MERGED commit batch — several independent sub-groups
+        (one per source transaction/connection), each failure-atomic on
+        its own (ISSUE 6 tentpole).  Fast path: the whole merged batch
+        appends as one packed buffer per touched segment; if anything
+        fails, everything rolls back and the sub-groups retry
+        INDIVIDUALLY, so exactly the failing sub-group(s) are NACKed
+        while siblings land durably.  Returns one ``None`` (logged) or
+        ``Exception`` (NACKed, fully rolled back) per sub-group."""
+        from antidote_tpu import faults as _faults
+
+        groups = [list(g) for g in groups]
+        # fast path: the whole merged batch as one packed buffer per
+        # touched segment.  Skipped while a fault injector is armed —
+        # a one-shot injected append fault must fire against exactly
+        # one sub-group (deterministic chaos), not be consumed by the
+        # merged attempt and then masked by the per-group redo below.
+        if len(groups) > 1 and _faults.get_injector() is None:
+            try:
+                self.log_effects([e for g in groups for e in g])
+                return [None] * len(groups)
+            except Exception:
+                pass  # fully rolled back; isolate the refusal per group
+        errors: List[Optional[Exception]] = []
+        for g in groups:
+            try:
+                self.log_effects(g)
+            except Exception as e:
+                errors.append(e)
+            else:
+                errors.append(None)
+        return errors
 
     def set_sync(self, sync: bool) -> None:
         """Runtime fsync-on-commit toggle (logging_vnode:set_sync_log,
@@ -248,35 +413,79 @@ class LogManager:
         for w in self.wals:
             w.set_sync(sync)
 
-    def commit_barrier(self, shards) -> None:
+    def barrier_async(self, shards) -> FsyncTicket:
+        """Deferred commit barrier: flush each touched shard's current
+        segment, rotate it, and — under sync_log=true — submit the
+        dirty segments to the group-fsync coordinator.  The returned
+        ticket completes when the covering fsync does (immediately under
+        sync_log=false); acks must not release before ``ticket.wait()``
+        returns."""
+        to_sync: List[ShardWAL] = []
         for p in set(int(s) for s in shards):
-            self.wals[p].commit()
+            w = self.wals[p]
+            cur = w.current
+            if cur.sync_on_commit and cur.pending_bytes:
+                to_sync.append(cur)
+            else:
+                cur.commit()
+            w.rotate()
+        if not to_sync:
+            return ready_ticket()
+        return self._fsync.submit(to_sync)
+
+    def commit_barrier(self, shards) -> None:
+        """Blocking barrier (legacy callers: remote ingress, handoff,
+        readiness probes).  Routed through the coordinator so a barrier
+        racing a deferred one coalesces into the same fsync pass."""
+        self.barrier_async(shards).wait()
+
+    def segment_depths(self) -> List[int]:
+        """Unsynced bytes per segment INDEX, aggregated across shards
+        (the antidote_wal_segment_depth gauge)."""
+        out = [0] * self.n_segments
+        for w in self.wals:
+            for i, s in enumerate(w.segs):
+                out[i] += s.pending_bytes
+        return out
 
     def probe_append(self) -> None:
         """Raise while ANY shard's WAL appends would still fail
         (degraded-mode recovery probe — see ShardWAL.probe).  Every
-        shard is probed: a failure scoped to one file (bad block,
-        per-file fault rule) must keep the node read-only, not flap it
-        out on a healthy sibling's success."""
+        shard (and every segment) is probed: a failure scoped to one
+        file (bad block, per-file fault rule) must keep the node
+        read-only, not flap it out on a healthy sibling's success."""
         for w in self.wals:
             w.probe()
 
     def truncate_shard(self, shard: int) -> None:
-        """Discard one shard's log (post-handoff cleanup: the records now
-        live in the receiver's chain).  Resets the shard's op-id chains and
-        blob-dedup memory along with the file."""
-        path = os.path.join(self.dir, f"shard_{shard}.wal")
+        """Discard one shard's log — ALL its segments (post-handoff
+        cleanup: the records now live in the receiver's chain).  Resets
+        the shard's op-id chains, append sequence and blob-dedup memory
+        along with the files."""
+        sync = self.wals[shard].sync_on_commit
         self.wals[shard].close()
-        if os.path.exists(path):
-            os.remove(path)
-        self.wals[shard] = ShardWAL(
-            path, sync_on_commit=self.wals[shard].sync_on_commit
+        for path in shard_segment_paths(self.dir, shard, self.n_segments):
+            if os.path.exists(path):
+                os.remove(path)
+        self.wals[shard] = SegmentedShardWAL(
+            self.dir, shard, self.n_segments, sync_on_commit=sync
         )
         self.op_ids[shard] = 0
+        self.seqs[shard] = 0
         self._blob_seen[shard].clear()
 
     def replay_shard(self, shard: int) -> Iterator[dict]:
-        return replay(os.path.join(self.dir, f"shard_{shard}.wal"))
+        """Replay one shard's records in exact append order, merged
+        across its segments by the ``"q"`` sequence.  Side effect: the
+        shard's append-sequence counter resumes past every replayed
+        record, so a recovered node's fresh appends never reuse a
+        sequence (recovery always replays every shard)."""
+        for rec in replay_segments(
+                shard_segment_paths(self.dir, shard, self.n_segments)):
+            q = rec.get("q")
+            if q is not None and q > self.seqs[shard]:
+                self.seqs[shard] = int(q)
+            yield rec
 
     def replay_key(self, shard: int, key, bucket: str) -> List[dict]:
         """Scan one shard's log for a key's ops (the reference's whole-log
@@ -289,5 +498,6 @@ class LogManager:
         ]
 
     def close(self) -> None:
+        self._fsync.close()
         for w in self.wals:
             w.close()

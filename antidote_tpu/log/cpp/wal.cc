@@ -130,6 +130,28 @@ int64_t wal_append(void* handle, const uint8_t* payload, uint32_t len) {
   return static_cast<int64_t>(12 + len);
 }
 
+// Append a caller-framed buffer (one or many records already framed as
+// magic|len|crc|payload by the Python side) in as few write() calls as
+// the kernel allows.  This is the group-commit fast path: a merged
+// commit batch becomes ONE buffer build + ONE write per touched
+// segment instead of one ctypes round trip per record.  Returns bytes
+// written or -1 (partial writes are the caller's to truncate away via
+// wal_truncate — same contract as wal_append).
+int64_t wal_append_raw(void* handle, const uint8_t* buf, uint64_t len) {
+  Wal* w = static_cast<Wal*>(handle);
+  uint64_t off = 0;
+  while (off < len) {
+    ssize_t n = ::write(w->fd, buf + off, len - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    off += static_cast<uint64_t>(n);
+  }
+  w->appended_bytes.fetch_add(len);
+  return static_cast<int64_t>(len);
+}
+
 // Commit barrier: make everything appended so far durable if
 // sync_on_commit; otherwise just a write barrier (group commit happens via
 // the background syncer).

@@ -50,7 +50,8 @@ class MetaDataStore:
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(self._data, use_bin_type=True))
             f.flush()
-            os.fsync(f.fileno())
+            os.fsync(f.fileno())  # fsync-ok: stable-meta atomic replace
+            # (write-temp + rename), not a log append
         os.replace(tmp, self.path)
 
     # -- local table (read_meta_data / insert_meta_data) ---------------

@@ -66,32 +66,63 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self, name: str, help_: str = ""):
+    """Scalar gauge, optionally labeled (``label_names``): the labeled
+    form keys one value per label tuple — e.g. the per-segment WAL
+    depth gauge, ``antidote_wal_segment_depth{segment="0"}``."""
+
+    def __init__(self, name: str, help_: str = "",
+                 label_names: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_
+        self.label_names = label_names
         self._value = 0.0
+        self._values: Dict[Tuple[str, ...], float] = {}
         self._lock = threading.Lock()
 
-    def set(self, v: float) -> None:
+    def _key(self, labels) -> Tuple[str, ...]:
+        return tuple(str(labels.get(n, "")) for n in self.label_names)
+
+    def set(self, v: float, **labels) -> None:
         with self._lock:
-            self._value = v
+            if self.label_names:
+                self._values[self._key(labels)] = v
+            else:
+                self._value = v
 
-    def inc(self, amount: float = 1.0) -> None:
+    def inc(self, amount: float = 1.0, **labels) -> None:
         with self._lock:
-            self._value += amount
+            if self.label_names:
+                k = self._key(labels)
+                self._values[k] = self._values.get(k, 0.0) + amount
+            else:
+                self._value += amount
 
-    def dec(self, amount: float = 1.0) -> None:
-        self.inc(-amount)
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
 
-    def value(self) -> float:
+    def value(self, **labels) -> float:
+        if self.label_names:
+            return self._values.get(self._key(labels), 0.0)
         return self._value
 
+    def snapshot(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> List[str]:
-        return [
+        out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} gauge",
-            f"{self.name} {self._value:g}",
         ]
+        if not self.label_names:
+            out.append(f"{self.name} {self._value:g}")
+            return out
+        with self._lock:
+            vals = dict(self._values)
+        for key, v in sorted(vals.items()):
+            labels = dict(zip(self.label_names, key))
+            out.append(f"{self.name}{_fmt_labels(labels)} {v:g}")
+        return out
 
 
 #: the reference's staleness buckets: ms 1..10000
@@ -176,8 +207,8 @@ class MetricsRegistry:
     def counter(self, name, help_="", label_names=()):
         return self.register(Counter(name, help_, tuple(label_names)))
 
-    def gauge(self, name, help_=""):
-        return self.register(Gauge(name, help_))
+    def gauge(self, name, help_="", label_names=()):
+        return self.register(Gauge(name, help_, tuple(label_names)))
 
     def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS):
         return self.register(Histogram(name, help_, buckets))
@@ -417,6 +448,33 @@ class NodeMetrics:
         self.serving_epoch_id = r.gauge(
             "antidote_serving_epoch_id",
             "Monotone id of the last published serving epoch",
+        )
+        # write plane (ISSUE 6): cross-connection group commit, parallel
+        # WAL group fsync, and the commutative-update cert bypass
+        self.commit_merge_width = r.histogram(
+            "antidote_commit_merge_width",
+            "Write-bearing transactions fused per merged commit batch "
+            "(one lock take / certification pass / WAL append / device "
+            "scatter each)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024),
+        )
+        self.wal_fsync_batch = r.histogram(
+            "antidote_wal_fsync_batch",
+            "Commit barriers covered per group-fsync pass (sync_log="
+            "true; >1 means barriers coalesced into one fsync)",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.wal_segment_depth = r.gauge(
+            "antidote_wal_segment_depth",
+            "Bytes appended since the segment's last commit barrier/"
+            "fsync, per WAL segment index (in-flight durability debt)",
+            label_names=("segment",),
+        )
+        self.cert_bypass = r.counter(
+            "antidote_cert_bypass_total",
+            "Transactions that skipped certification via the blind-"
+            "commutative bypass (no reads, commutative-type blind "
+            "updates only, no explicit certify=true)",
         )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)

@@ -54,18 +54,23 @@ _STOP = object()
 
 
 class _StaticWork:
-    """One client's static read/update parked at the batch gate."""
+    """One client's static read/update — or an interactive COMMIT — parked
+    at the batch gate / locked-plane merge point."""
 
     __slots__ = ("kind", "objects", "updates", "clock", "event", "result",
                  "error", "deadline", "t_submit", "wants_bytes",
-                 "reply_bytes")
+                 "reply_bytes", "txid")
 
     def __init__(self, kind, objects=None, updates=None, clock=None,
-                 deadline=None, wants_bytes=False):
+                 deadline=None, wants_bytes=False, txid=None):
         self.kind = kind
         self.objects = objects
         self.updates = updates
         self.clock = clock
+        #: interactive commit works (kind == "commit") carry the txid;
+        #: the locked worker resolves it to the registered Transaction
+        #: at the merge point
+        self.txid = txid
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -127,7 +132,8 @@ class ProtocolServer:
                  max_in_flight_per_client: int = 64, queue_max: int = 4096,
                  default_deadline_ms: Optional[float] = None,
                  epoch_tick_ms: float = 100.0,
-                 snapshot_cache_size: Optional[int] = None):
+                 snapshot_cache_size: Optional[int] = None,
+                 group_commit_window_us: float = 0.0):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
@@ -198,12 +204,19 @@ class ProtocolServer:
         #: backpressures the bounded batch gate) instead of queueing
         #: device handles without limit.
         self._writeback_q: "queue.Queue" = queue.Queue(maxsize=16)
-        #: the LOCKED plane's feed: update groups and reads the epoch
-        #: cannot serve, processed by a dedicated worker so a commit
-        #: group (or an XLA compile hiding inside one) never parks the
-        #: dispatcher's read-launch stage.  BOUNDED: past the cap the
-        #: work sheds with a typed busy error, same as the batch gate.
+        #: the LOCKED plane's feed: update groups, interactive COMMITs
+        #: (the cross-connection group-commit merge point, ISSUE 6) and
+        #: reads the epoch cannot serve, processed by a dedicated worker
+        #: so a commit group (or an XLA compile hiding inside one) never
+        #: parks the dispatcher's read-launch stage.  BOUNDED: past the
+        #: cap the work sheds with a typed busy error, same as the gate.
         self._locked_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        #: optional gather window at the merge point: after the locked
+        #: worker's first dequeue it keeps draining up to this long, so
+        #: moderate-load commit groups widen before taking the commit
+        #: lock once.  0 (default) = natural batching only (whatever
+        #: queued during the previous group's execution).
+        self._group_window_s = max(0.0, float(group_commit_window_us)) / 1e6
         self._ticker_stop = threading.Event()
         if batch_static:
             self._batcher = threading.Thread(
@@ -480,18 +493,28 @@ class ProtocolServer:
         return vals, vc_list
 
     def static_update(self, updates, clock, deadline=None):
-        """Batched static update: commit VC (raises AbortError on cert)."""
+        """Batched static update: commit VC (raises AbortError on cert).
+        Parks DIRECTLY at the locked worker's merge point — the
+        dispatcher stage only ever forwarded updates, and the extra
+        queue hop + thread wakeup per write was measurable on the
+        2-core write-plane floor (ISSUE 6)."""
         if not self.batch_static:
             with self._lock:
                 check_deadline(deadline, "dispatch")
                 return self.node.update_objects(updates, clock=_vc(clock))
         return self._submit(_StaticWork("update", updates=updates,
                                         clock=_vc(clock),
-                                        deadline=deadline))
+                                        deadline=deadline),
+                            self._locked_q)
 
-    def _submit(self, work: _StaticWork):
+    def _submit(self, work: _StaticWork, q: Optional["queue.Queue"] = None):
+        """Park a work on a pipeline queue (default: the batch gate;
+        interactive commits go straight to the locked-plane merge point
+        — one hop fewer) and wait for its stage to reply."""
         if self._closing:
             raise ConnectionError("server shutting down")
+        if q is None:
+            q = self._static_q
         now = time.monotonic()
         work.t_submit = now
         t0 = getattr(self._tls, "t0", None)
@@ -501,29 +524,41 @@ class ProtocolServer:
         try:
             # bounded gate: shed with a typed busy error instead of
             # parking behind an unbounded backlog
-            self._static_q.put_nowait(work)
+            q.put_nowait(work)
         except queue.Full:
             self.metrics.shed.inc(plane="server_queue")
             raise BusyError(
-                f"static batch gate full ({self._static_q.maxsize} "
-                "requests parked)", retry_after_ms=100,
+                f"static batch gate full ({q.maxsize} requests parked)",
+                retry_after_ms=100,
             ) from None
-        self.metrics.commit_gate_depth.set(self._static_q.qsize())
+        if q is self._static_q:
+            self.metrics.commit_gate_depth.set(q.qsize())
         if not work.event.wait(timeout=300):
             raise TimeoutError("static batch dispatcher stalled")
         if work.error is not None:
             raise work.error
         return work.result
 
-    def _drain_batch(self, q):
+    def _drain_batch(self, q, window_s: float = 0.0):
         """Block for one work, drain whatever else queued (up to
-        ``_batch_max``).  Returns (works, stop_seen)."""
+        ``_batch_max``); with ``window_s`` keep gathering late arrivals
+        up to that long (the --group-commit-window-us merge window).
+        Returns (works, stop_seen)."""
         batch = [q.get()]
+        deadline = (time.monotonic() + window_s) if window_s > 0 else None
         while len(batch) < self._batch_max:
             try:
                 batch.append(q.get_nowait())
             except queue.Empty:
-                break
+                if deadline is None:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    batch.append(q.get(timeout=left))
+                except queue.Empty:
+                    break
         stop = any(w is _STOP for w in batch)
         return [w for w in batch if w is not _STOP], stop
 
@@ -619,26 +654,38 @@ class ProtocolServer:
                 return
 
     def _locked_loop(self):
-        """The LOCKED plane's worker: update group commits and the reads
-        the epoch path cannot serve (clocks ahead of the epoch, composite
-        maps, promoted keys, no epoch yet).  Runs under ``self._lock`` —
-        serialized against nothing but itself and inline (batch_static
-        off) dispatch; the epoch read plane never waits for it."""
+        """The LOCKED plane's worker — and the write plane's MERGE POINT
+        (ISSUE 6): static update groups and interactive COMMITs arriving
+        on different connections drain into ONE merged batch that takes
+        the commit lock once, certifies once, appends once and scatters
+        once, with per-source acks fanned back out.  Also serves the
+        reads the epoch path cannot (clocks ahead of the epoch,
+        composite maps, promoted keys, no epoch yet).  Runs under
+        ``self._lock`` — serialized against nothing but itself and
+        inline (batch_static off) dispatch; the epoch read plane never
+        waits for it."""
         q = self._locked_q
         while True:
-            works, stop = self._drain_batch(q)
-            # re-checked at THIS dequeue too: a work can expire while
-            # parked behind a slow commit group (this plane's whole job
-            # is absorbing those)
-            works = self._shed_expired(works, "locked plane")
+            works, stop = self._drain_batch(q, self._group_window_s)
+            # re-checked at THIS dequeue too (the overload contract at
+            # the merge point): a work can expire while parked behind a
+            # slow commit group — this plane's whole job is absorbing
+            # those.  Write works park here directly (no dispatcher
+            # hop), so this dequeue also owns their parked-stage clock;
+            # rerouted reads were already observed at the batch gate.
+            writes = self._shed_expired(
+                [w for w in works if w.kind != "read"], "locked plane",
+                observe_parked=True)
+            reads = self._shed_expired(
+                [w for w in works if w.kind == "read"], "locked plane")
             try:
-                ups = [w for w in works if w.kind == "update"]
-                reads = [w for w in works if w.kind == "read"]
+                ups = [w for w in writes if w.kind == "update"]
+                commits = [w for w in writes if w.kind == "commit"]
                 with self._lock:
-                    # updates first: the merged read then serves at a
+                    # writes first: the merged read then serves at a
                     # snapshot covering them (fresh + cache friendly)
-                    if ups:
-                        self._run_update_group(ups)
+                    if ups or commits:
+                        self._run_commit_merge(ups, commits)
                     if reads:
                         self._run_read_group(reads)
             except BaseException as e:  # never strand a parked connection
@@ -910,27 +957,54 @@ class ProtocolServer:
             return np.asarray(member.stable_vc())
         return None
 
-    def _run_update_group(self, works: List[_StaticWork]) -> None:
+    def _run_commit_merge(self, ups: List[_StaticWork],
+                          commits: List[_StaticWork]) -> None:
+        """The write plane's merge point (ISSUE 6): static update groups
+        AND interactive COMMITs from different connections fuse into ONE
+        ``commit_transactions_group`` call — one commit-lock take, one
+        certification pass, one WAL append, one device scatter — with
+        per-source results fanned back out (a member's failure-atomic
+        rollback rolls back only its own sub-group)."""
         txm = getattr(self.node, "txm", None)
-        if txm is None or len(works) == 1:
-            # cluster coordinator (2PC) or a lone update: sequential path
-            for w in works:
+        if txm is None:
+            # cluster coordinator (2PC): sequential legacy path (commit
+            # works are never routed here without a txm)
+            for w in ups:
                 try:
                     w.result = self.node.update_objects(w.updates,
                                                         clock=w.clock)
                 except Exception as e:
                     w.error = e
                 w.event.set()
+            for w in commits:
+                w.error = RuntimeError("commit merge requires a local txm")
+                w.event.set()
             return
-        pending = list(works)
-        # Group members share a snapshot, so two blind writes to one hot
-        # key first-committer-abort each other — a conflict the pre-batch
-        # serial path could never produce (each request's snapshot
-        # followed the previous commit).  Losers retry as a FOLLOW-UP
-        # GROUP at a fresh snapshot (≥1 winner per round → ≤N rounds,
-        # still one device append per round) — equivalent to some serial
-        # interleaving, so no spurious abort escapes to a client.
-        while pending:
+        # resolve interactive commit works to their registered txns
+        # (self._lock is held by the locked worker)
+        inter: List = []
+        for w in commits:
+            txn = self._txns.get(w.txid)
+            if txn is None or not txn.active:
+                w.error = KeyError(
+                    f"unknown or finished transaction {w.txid}")
+                w.event.set()
+                continue
+            inter.append((w, txn))
+        pending = list(ups)
+        first = True
+        # Static group members share a snapshot, so two read-bearing
+        # writes to one hot key first-committer-abort each other — a
+        # conflict the pre-batch serial path could never produce (each
+        # request's snapshot followed the previous commit).  Losers
+        # retry as a FOLLOW-UP GROUP at a fresh snapshot (≥1 winner per
+        # round → ≤N rounds, still one device append per round) —
+        # equivalent to some serial interleaving, so no spurious abort
+        # escapes to a client.  (Blind commutative updates bypass
+        # certification entirely and never enter this loop's retries.)
+        # Interactive commits ride the FIRST round only: their abort is
+        # the client's to observe, never auto-retried.
+        while pending or (first and inter):
             staged = []
             for w in pending:
                 # re-check per-work deadlines at every retry round: a
@@ -955,24 +1029,30 @@ class ProtocolServer:
                 except Exception as e:
                     w.error = e
                     w.event.set()
-            if not staged:
+            batch = staged + (inter if first else [])
+            first = False
+            if not batch:
                 return
             try:
-                outs = txm.commit_transactions_group([t for _, t in staged])
+                outs = txm.commit_transactions_group(
+                    [t for _, t in batch])
             except Exception as e:
-                # a backlog-shed group comes back with its txns still
-                # OPEN (retryable for interactive holders) — but these
-                # txns are server-created and the static clients only
-                # ever see the error reply, so abort them here
-                for w, txn in staged:
-                    if txn.active:
+                for w, txn in batch:
+                    # a backlog-shed group comes back with its txns
+                    # still OPEN — server-created static txns must be
+                    # aborted here (their clients only see the error
+                    # reply); an interactive holder's txn stays open on
+                    # BusyError so the SAME commit is retryable, and on
+                    # any other failure the _process wrapper unregisters
+                    # the (now closed) txn
+                    if w.kind == "update" and txn.active:
                         txm.abort_transaction(txn)
                     w.error = e
                     w.event.set()
                 return
             retry = []
-            for (w, _), r in zip(staged, outs):
-                if isinstance(r, AbortError):
+            for (w, txn), r in zip(batch, outs):
+                if isinstance(r, AbortError) and w.kind == "update":
                     retry.append(w)
                 elif isinstance(r, Exception):
                     w.error = r
@@ -1014,6 +1094,39 @@ class ProtocolServer:
                 _decode_updates(body["updates"]), body.get("clock"),
                 deadline=deadline,
             )
+            return MessageCode.COMMIT_RESP, {
+                "commit_clock": [int(x) for x in vc]
+            }
+        if (code == MessageCode.COMMIT_TRANSACTION and self.batch_static
+                and getattr(self.node, "txm", None) is not None):
+            # interactive commits join the cross-connection merge point
+            # (ISSUE 6): instead of serializing through the dispatch
+            # lock one at a time, the commit parks at the locked
+            # worker and fuses with whatever static updates and OTHER
+            # connections' commits drained in the same batch
+            txid = body["txid"]
+            w = _StaticWork("commit", deadline=deadline, txid=txid)
+            try:
+                vc = self._submit(w, self._locked_q)
+            except BusyError:
+                # the txn stays OPEN and registered: the busy reply's
+                # retry-after hint is honest — the SAME commit can be
+                # resubmitted (manager backlog-shed semantics)
+                raise
+            except BaseException:
+                # unregister AND abort-if-still-open: a work shed at
+                # the merge-point dequeue (deadline, queue overflow,
+                # shutdown) never reached the commit group, so the txn
+                # is still ACTIVE — popping it without aborting would
+                # orphan an open txn nothing can reach, pinning the
+                # certification-GC floor forever
+                with self._lock:
+                    txn = self._txns.pop(txid, None)
+                if txn is not None and txn.active:
+                    self.node.abort_transaction(txn)
+                raise
+            with self._lock:
+                self._txns.pop(txid, None)
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
             }
@@ -1172,6 +1285,7 @@ class ProtocolServer:
             "serving_epoch_id": int(m.serving_epoch_id.value()),
             "writeback_depth": self._writeback_q.qsize(),
             "locked_depth": self._locked_q.qsize(),
+            "group_commit_window_us": round(self._group_window_s * 1e6, 1),
         }
         txm = getattr(self.node, "txm", None)
         if txm is not None:

@@ -12,7 +12,7 @@ like Antidote's ``{Key, Type, Bucket}`` bound objects.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -428,16 +428,42 @@ class KVStore:
         ``origins[i]``.  Groups by type into single scatter+ring appends
         (the batched analogue of clocksi_vnode:update_materializer,
         /root/reference/src/clocksi_vnode.erl:634-657).
+
+        Blocking form: ONE failure-atomic group — a WAL refusal raises
+        before any device table mutates, and the commit barrier (fsync
+        under sync_log=true) completes before the device apply, so the
+        callers with retry loops (remote ingress, recovery) never
+        double-apply.
         """
+        errors, _ = self.apply_effect_groups(
+            [(list(effects), list(commit_vcs), list(origins))],
+            defer_sync=False,
+        )
+        if errors[0] is not None:
+            raise errors[0]
+
+    def apply_effect_groups(self, groups, defer_sync: bool = True):
+        """Apply a MERGED commit batch: several independent sub-groups
+        (one per source transaction), each failure-atomic on its own —
+        the write-plane merge seam (ISSUE 6).  A sub-group whose WAL
+        append is refused (ENOSPC mid-batch) is NACKed and rolled back
+        alone; sibling sub-groups still log, scatter and ack.
+
+        ``groups``: list of ``(effects, commit_vcs, origins)`` per
+        sub-group.  Returns ``(errors, ticket)``: one ``None`` or
+        ``Exception`` per sub-group, and — with ``defer_sync`` — the
+        group-fsync ticket acks must wait on (None when nothing was
+        logged; the fsync runs CONCURRENTLY with the device scatter)."""
         self._mutating = True
         self.mutation_epoch += 1
         try:
-            self._apply_effects_inner(effects, commit_vcs, origins)
+            return self._apply_effect_groups_inner(groups, defer_sync)
         finally:
             self.mutation_epoch += 1
             self._mutating = False
 
-    def _apply_effects_inner(self, effects, commit_vcs, origins) -> None:
+    def _apply_effect_groups_inner(self, groups, defer_sync):
+        effects = [e for g in groups for e in g[0]]
         self.locate_many([(e.key, e.type_name, e.bucket) for e in effects])
         # ---- overflow escape hatch: promote BEFORE anything can drop.
         # Aggregate each key's worst-case fresh-slot demand (+ the minimum
@@ -470,44 +496,74 @@ class KVStore:
                 t.slots_ub[shard, row] += d
                 continue
             self._promote_key(dk, extra_demand=d, min_tier=need_t)
+        # per-sub-group record build (blob intern rides along, as
+        # before); the resolved (tiered name, shard, row) rides to the
+        # scatter loop so the hot path locates each effect once
+        to_log_groups: List[List[tuple]] = []
+        located: List[List[tuple]] = []
+        for effs, vcs, orgs in groups:
+            entries: List[tuple] = []
+            locs: List[tuple] = []
+            for i, eff in enumerate(effs):
+                loc = self.locate(eff.key, eff.type_name, eff.bucket)
+                locs.append(loc)
+                for h, data in eff.blob_refs:
+                    self.blobs.intern_bytes(h, data)
+                if self.log is not None:
+                    entries.append((
+                        loc[1], eff.key, eff.type_name, eff.bucket,
+                        eff.eff_a, eff.eff_b, vcs[i], orgs[i],
+                        eff.blob_refs,
+                    ))
+            to_log_groups.append(entries)
+            located.append(locs)
+        # durability first: log (with blob payloads) before any device
+        # apply — failure-atomically PER SUB-GROUP: a mid-batch ENOSPC
+        # NACKs and rolls back exactly the refused sub-group(s); a
+        # NACKed group can never partially resurrect on recovery, and
+        # its siblings still commit
+        errors: List[Optional[Exception]] = [None] * len(groups)
+        if self.log is not None and any(to_log_groups):
+            errors = self.log.log_effect_groups(to_log_groups)
+        # survivors only: cache invalidation, device scatter, clocks
+        ticket = None
         by_table: Dict[str, list] = {}
         touched = []
         inval: List[Tuple[Any, str]] = []
-        to_log: List[tuple] = []
-        for i, eff in enumerate(effects):
-            tname_t, shard, row = self.locate(eff.key, eff.type_name, eff.bucket)
-            inval.append((eff.key, eff.bucket))
-            # composite invalidation: a field/membership write kills the
-            # parent map's assembled value (recursively for nested maps)
-            k = eff.key
-            while type(k) is tuple and len(k) >= 2 and k[0] in _DERIVED_NS:
-                k = k[1]
-                inval.append((k, eff.bucket))
-            for h, data in eff.blob_refs:
-                self.blobs.intern_bytes(h, data)
-            if self.log is not None:
-                to_log.append((
-                    shard, eff.key, eff.type_name, eff.bucket,
-                    eff.eff_a, eff.eff_b, commit_vcs[i], origins[i],
-                    eff.blob_refs,
-                ))
-            by_table.setdefault(tname_t, []).append(
-                (shard, row, eff.eff_a, eff.eff_b, commit_vcs[i], origins[i])
-            )
-            touched.append((shard, np.asarray(commit_vcs[i], np.int32)))
-        if to_log:
-            # durability first: log (with blob payloads) before any device
-            # apply — and as ONE failure-atomic batch: a mid-group ENOSPC
-            # rolls the already-appended prefix back, so a commit group
-            # reported failed can never partially resurrect on recovery
-            self.log.log_effects(to_log)
+        for (effs, vcs, orgs), locs, err in zip(groups, located, errors):
+            if err is not None:
+                continue
+            for i, eff in enumerate(effs):
+                tname_t, shard, row = locs[i]
+                inval.append((eff.key, eff.bucket))
+                # composite invalidation: a field/membership write kills
+                # the parent map's assembled value (recursively for
+                # nested maps)
+                k = eff.key
+                while (type(k) is tuple and len(k) >= 2
+                       and k[0] in _DERIVED_NS):
+                    k = k[1]
+                    inval.append((k, eff.bucket))
+                by_table.setdefault(tname_t, []).append(
+                    (shard, row, eff.eff_a, eff.eff_b, vcs[i], orgs[i])
+                )
+                touched.append((shard, np.asarray(vcs[i], np.int32)))
+        if self.log is not None and touched:
+            # group fsync: deferred acks wait on the ticket AFTER the
+            # commit lock releases, so the fsync overlaps the device
+            # scatter below and the NEXT merged batch's certification;
+            # the blocking form (remote ingress, recovery) keeps the
+            # barrier-before-apply ordering so its retry loops never
+            # double-apply a device mutation
+            ticket = self.log.barrier_async([s for s, _ in touched])
+            if not defer_sync:
+                ticket.wait()
+                ticket = None
         if inval:
             # one locked sweep per batch, not one acquisition per effect
             with self._value_cache_lock:
                 for dk in inval:
                     self._value_cache.pop(dk, None)
-        if self.log is not None and touched:
-            self.log.commit_barrier([s for s, _ in touched])
         for tname_t, items in by_table.items():
             t = self.table(tname_t)
             aw = t.ty.eff_a_width(t.cfg)
@@ -525,6 +581,7 @@ class KVStore:
         # ops — the causal gate trusts it)
         for shard, vc in touched:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
+        return errors, ticket
 
     # ------------------------------------------------------------------
     # serving epochs (lock-split wire reads — ISSUE 5)

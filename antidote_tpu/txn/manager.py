@@ -96,6 +96,17 @@ class Transaction:
         #: tentative commit VC frozen at first overlay: all of the txn's
         #: uncommitted dots share one stamp (re-stamped at real commit)
         self.tentative_vc: Optional[np.ndarray] = None
+        #: True once the txn performed a client-level read — a
+        #: read-bearing txn is (potentially) read-modify-write and must
+        #: keep first-committer-wins certification
+        self.did_read = False
+        #: True once the txn buffered an update that certification must
+        #: cover: state-dependent downstreams (observed-remove, mv,
+        #: rga), escrow-guarded counter_b spends, composite maps, or
+        #: any type not marked ``commutative_blind``.  A txn with
+        #: neither flag set is a BLIND COMMUTATIVE writer and skips the
+        #: certification round entirely (ISSUE 6 bypass)
+        self.cert_required = False
 
     def pending_for(self, key, bucket) -> List[Effect]:
         return [e for e, _ in self.writeset if e.key == key and e.bucket == bucket]
@@ -186,6 +197,11 @@ class TransactionManager:
         #: (write-then-read freshness survives deferred publishes; 0 =
         #: every ack so far went out under a covering epoch)
         self.epoch_lag_counter = 0
+        #: monotonic time of the last INLINE (commit-path) epoch publish
+        #: and the epoch-plane read count seen then — see
+        #: EPOCH_INLINE_PUBLISH_S
+        self._last_inline_publish = 0.0
+        self._reads_at_last_publish = -1.0
 
     # ------------------------------------------------------------------
     # serving-epoch publication (lock-split wire reads)
@@ -263,8 +279,14 @@ class TransactionManager:
         assert txn.active
         # count client-level reads only — internal recursions (map fields,
         # downstream state reads) would inflate the dashboard rates
-        if self.metrics is not None and not _internal:
-            self.metrics.operations.inc(len(objects), type="read")
+        if not _internal:
+            # a client-level read makes the txn read-bearing: whatever it
+            # writes may depend on what it saw, so the commutativity
+            # bypass is off for it (internal downstream-state reads mark
+            # cert_required at the update site instead)
+            txn.did_read = True
+            if self.metrics is not None:
+                self.metrics.operations.inc(len(objects), type="read")
         out: List[Any] = [None] * len(objects)
         plain, comp = [], []
         composite_names = _composite_names()
@@ -441,6 +463,7 @@ class TransactionManager:
         if getattr(ty, "composite", False):
             # maps expand into membership + nested-field updates; children
             # skip bucket hooks (they already ran on the map op above)
+            txn.cert_required = True
             from antidote_tpu.crdt import maps as maps_mod
 
             def read_field_value(fk, ft):
@@ -454,6 +477,11 @@ class TransactionManager:
             return
         guarded_b = type_name == "counter_b" and op[0] in ("decrement",
                                                            "transfer")
+        # commutativity-bypass eligibility (ISSUE 6): only a blind
+        # effect of a commutative type leaves the flag untouched
+        if (guarded_b or ty.require_state_downstream(op)
+                or not getattr(ty, "commutative_blind", False)):
+            txn.cert_required = True
         state = None
         # the key's slot-tier cfg: a promoted key's state (and the effect
         # lanes its downstream emits, e.g. mv observed ids) has the wider
@@ -510,6 +538,17 @@ class TransactionManager:
 
     #: recovery probes while read-only are spaced at least this far apart
     RO_PROBE_INTERVAL_S = 0.25
+
+    #: while the epoch plane is IDLE (no epoch-path read since the last
+    #: inline publish — a pure write storm), inline publishes are rate-
+    #: limited to one per window: deferring batches raise the epoch-lag
+    #: floor, so any read that does arrive falls back to the always-
+    #: fresh locked path, and the next publish (or the ticker) covers
+    #: them.  The moment epoch reads flow again, every write batch
+    #: publishes before its ack as before — deferring under a MIXED
+    #: load would reroute the read majority to the locked plane and
+    #: blow up its tail (measured: config-3 p99 0.5 s → 2.9 s).
+    EPOCH_INLINE_PUBLISH_S = 0.025
 
     def check_writable(self) -> None:
         """Raise :class:`ReadOnlyError` while the node is in degraded
@@ -609,15 +648,40 @@ class TransactionManager:
                             # A deferred/failed publish raises the lag
                             # floor instead — epoch reads below it fall
                             # back to the (always-fresh) locked path.
-                            try:
-                                st = self._publish_serving_epoch_locked()
-                            except Exception:
-                                st = "error"
-                                log.exception(
-                                    "serving-epoch publish failed")
-                            if st not in ("published", "noop"):
+                            # WRITE-STORM DEFERRAL (ISSUE 6): with the
+                            # epoch plane idle (no epoch-path read since
+                            # the last publish), the per-batch publish
+                            # scatter was >60% of batch cost serving
+                            # nobody — those batches defer (lag floor
+                            # up; any arriving read stays correct via
+                            # the locked path) up to the rate window.
+                            # The moment epoch reads flow, every batch
+                            # publishes before its ack again (deferring
+                            # mixed loads reroutes the read majority to
+                            # the locked plane and blows up its tail).
+                            now2 = time.monotonic()
+                            reads_now = -1.0
+                            if self.metrics is not None:
+                                sr = self.metrics.serving_reads
+                                reads_now = (sr.value(path="cache")
+                                             + sr.value(path="gather"))
+                            idle = (reads_now ==
+                                    self._reads_at_last_publish)
+                            if (idle and now2 - self._last_inline_publish
+                                    < self.EPOCH_INLINE_PUBLISH_S):
                                 self.epoch_lag_counter = self.commit_counter
-                        return out
+                            else:
+                                self._last_inline_publish = now2
+                                self._reads_at_last_publish = reads_now
+                                try:
+                                    st = self._publish_serving_epoch_locked()
+                                except Exception:
+                                    st = "error"
+                                    log.exception(
+                                        "serving-epoch publish failed")
+                                if st not in ("published", "noop"):
+                                    self.epoch_lag_counter = (
+                                        self.commit_counter)
                     except OSError as e:
                         if has_writes and e.errno in (errno.ENOSPC,
                                                       errno.EIO,
@@ -635,6 +699,14 @@ class TransactionManager:
                         if self.metrics is not None and has_writes:
                             self.metrics.commit_seconds.observe(
                                 time.monotonic() - t0)
+                            self.metrics.commit_merge_width.observe(
+                                sum(1 for t in txns if t.writeset))
+                if (self.metrics is not None and has_writes
+                        and self.store.log is not None):
+                    for i, d in enumerate(self.store.log.segment_depths()):
+                        self.metrics.wal_segment_depth.set(d,
+                                                           segment=str(i))
+                return out
             finally:
                 with self._backlog_lock:
                     self._commit_backlog -= 1
@@ -648,14 +720,40 @@ class TransactionManager:
                     self._mark_aborted(t)
             raise
 
+    def _wal_refusal(self, e: Exception) -> Exception:
+        """Map a sub-group's WAL refusal to the client-facing error: a
+        disk-class errno flips the read-only degraded mode (once) and
+        surfaces typed; anything else passes through."""
+        if isinstance(e, OSError) and e.errno in (errno.ENOSPC, errno.EIO,
+                                                  errno.EROFS, errno.EDQUOT):
+            if self.read_only_reason is None:
+                self._enter_read_only(e)
+            out = ReadOnlyError(self.read_only_reason)
+            out.__cause__ = e
+            return out
+        return e
+
     def _commit_group_locked(self, txns: Sequence[Transaction]):
+        """One merged commit batch under the lock: vectorized
+        certification, one counter mint per member, ONE grouped
+        WAL-append + device scatter, then — under sync_log=true — the
+        covering group fsync (overlapped with the scatter; awaited
+        BEFORE listeners run, so nothing non-durable ever reaches the
+        serving epoch or the inter-DC stream), listeners per member.
+        Returns the per-txn results."""
         out: List[Any] = []
-        pend: List[tuple] = []  # (txn, commit_vc, effects)
-        # rollback state for a failed apply (ENOSPC): certification
-        # stamps written for a group that is then NACKed would cause
-        # first-committer-aborts against phantom writes forever after
-        prev_counter = self.commit_counter
-        prev_stamps: Dict[tuple, Optional[int]] = {}
+        # (out idx, txn, commit_vc, effects, stamped {ck: prev}, counter)
+        pend: List[tuple] = []
+        # vectorized certification (ISSUE 6): ONE pass over the stamp
+        # table up front — each unique written key is looked up once for
+        # the whole merged batch (Zipf batches repeat hot keys across
+        # members), then members check/update the small batch-local view
+        last_seen: Dict[tuple, int] = {}
+        for txn in txns:
+            for eff, _ in txn.writeset:
+                ck = (eff.key, eff.bucket)
+                if ck not in last_seen:
+                    last_seen[ck] = self.committed_keys.get(ck, 0)
         for txn in txns:
             assert txn.active
             txn.active = False
@@ -665,13 +763,24 @@ class TransactionManager:
             if not txn.writeset:
                 out.append(txn.snapshot_vc.copy())
                 continue
-            cert = txn.props.get("certify", self.cert)
+            explicit = txn.props.get("certify")
+            cert = self.cert if explicit is None else bool(explicit)
+            # commutativity bypass: blind updates of commutative types
+            # from a txn that read nothing need no first-committer-wins
+            # round — their effects commute, so every interleaving
+            # converges (reference certify=false analogue, automatic).
+            # An EXPLICIT certify=true prop opts back in (parity).
+            bypass = (cert and explicit is None and not txn.did_read
+                      and not txn.cert_required)
+            if bypass:
+                cert = False
+                if self.metrics is not None:
+                    self.metrics.cert_bypass.inc()
             conflict = None
             if cert:
                 snap_here = int(txn.snapshot_vc[self.my_dc])
                 for eff, _ in txn.writeset:
-                    last = self.committed_keys.get((eff.key, eff.bucket), 0)
-                    if last > snap_here:
+                    if last_seen[(eff.key, eff.bucket)] > snap_here:
                         conflict = eff.key
                         break
             if conflict is not None:
@@ -699,36 +808,85 @@ class TransactionManager:
             if self.metrics is not None:
                 self.metrics.commit_batch_size.observe(len(effects))
             # mark BEFORE later group members certify: a group peer whose
-            # snapshot predates this commit must first-committer-abort
-            for eff, _ in txn.writeset:
-                ck = (eff.key, eff.bucket)
-                if ck not in prev_stamps:
-                    prev_stamps[ck] = self.committed_keys.get(ck)
-                self.committed_keys[ck] = self.commit_counter
-            pend.append((txn, commit_vc, effects))
+            # snapshot predates this commit must first-committer-abort.
+            # Bypassed (blind commutative) members never touch the stamp
+            # table at all — a blind write invalidates nobody, and under
+            # Zipf blind-heavy load the table stays small.
+            stamped: Dict[tuple, Optional[int]] = {}
+            if not bypass:
+                for eff, _ in txn.writeset:
+                    ck = (eff.key, eff.bucket)
+                    if ck not in stamped:
+                        stamped[ck] = self.committed_keys.get(ck)
+                    self.committed_keys[ck] = self.commit_counter
+                    last_seen[ck] = self.commit_counter
+            pend.append((len(out), txn, commit_vc, effects, stamped,
+                         self.commit_counter))
             out.append(commit_vc)
         if pend:
-            all_effs: List = []
-            all_vcs: List = []
-            for _, vc, effs in pend:
-                all_effs.extend(effs)
-                all_vcs.extend([vc] * len(effs))
+            groups = [
+                (effs, [vc] * len(effs), [self.my_dc] * len(effs))
+                for _i, _t, vc, effs, _s, _c in pend
+            ]
             try:
-                self.store.apply_effects(
-                    all_effs, all_vcs, [self.my_dc] * len(all_effs)
-                )
+                errors, ticket = self.store.apply_effect_groups(groups)
             except BaseException:
-                # nothing durable or device-visible happened (the WAL
-                # batch rolled itself back): un-stamp the certification
-                # marks and counters too, or later txns would first-
-                # committer-abort against writes that never existed
-                self.commit_counter = prev_counter
-                for ck, old in prev_stamps.items():
-                    if old is None:
-                        self.committed_keys.pop(ck, None)
-                    else:
-                        self.committed_keys[ck] = old
+                # a non-WAL failure (device error): nothing scattered —
+                # un-stamp every member's marks and counters, or later
+                # txns would first-committer-abort against writes that
+                # never existed
+                for _i, _t, _vc, _e, stamped, ctr in reversed(pend):
+                    for ck, old in stamped.items():
+                        if self.committed_keys.get(ck) == ctr:
+                            if old is None:
+                                self.committed_keys.pop(ck, None)
+                            else:
+                                self.committed_keys[ck] = old
+                self.commit_counter = pend[0][5] - 1
                 raise
+            ok: List[tuple] = []
+            # failure-atomic PER SUB-GROUP: a NACKed member rolls back
+            # only its own stamps (reverse order unwinds same-key
+            # overwrites; a sibling's newer stamp survives) and keeps
+            # its counter hole — holes are safe, certification compares
+            # magnitudes and safe-time pings may claim a ts that owns
+            # no txn (nothing will arrive for it)
+            for (i, txn, vc, effs, stamped, ctr), err in zip(
+                    reversed(pend), reversed(errors)):
+                if err is None:
+                    ok.append((i, txn, vc, effs))
+                    continue
+                for ck, old in stamped.items():
+                    if self.committed_keys.get(ck) == ctr:
+                        if old is None:
+                            self.committed_keys.pop(ck, None)
+                        else:
+                            self.committed_keys[ck] = old
+                out[i] = self._wal_refusal(err)
+            ok.reverse()  # commit order for listeners
+            # ACK/VISIBILITY GATE: the group fsync was submitted before
+            # the device scatter and ran concurrently with it; it must
+            # COMPLETE before commit listeners publish to the inter-DC
+            # stream (or the serving epoch publishes) — effects a crash
+            # could un-happen must never be externally visible, or a
+            # recovered node re-mints the same (shard, origin, opid)
+            # and remote DCs drop the new ops as duplicates.  A failed
+            # or stalled fsync fails every ack in the batch typed and
+            # flips read-only: the durable state is ambiguous until the
+            # volume heals (see docs/operations.md).
+            if ticket is not None:
+                try:
+                    try:
+                        ticket.wait()
+                    except TimeoutError as e:
+                        raise OSError(
+                            errno.EIO, f"WAL group fsync stalled: {e}"
+                        ) from e
+                except OSError as e:
+                    err = self._wal_refusal(e)
+                    for i, _t, _vc, _e in ok:
+                        out[i] = err
+                    ok = []
             # the group minted EVERY member's commit counter above, but
             # members publish one at a time below — so a safe-time read
             # from inside an early member's egress listener (the
@@ -738,9 +896,9 @@ class TransactionManager:
             # then drops their real messages as duplicates: permanently
             # lost effects.  The flag makes listeners defer heartbeats
             # until the whole group is on the stream.
-            self._publishing_group = len(pend) > 1
+            self._publishing_group = len(ok) > 1
             try:
-                for txn, commit_vc, effects in pend:
+                for _i, txn, commit_vc, effects in ok:
                     for listener in self.commit_listeners:
                         listener(effects, commit_vc, self.my_dc)
                     for eff, op in txn.writeset:

@@ -802,6 +802,82 @@ def bench_perf_smoke(assert_bounds: bool, json_path=None):
                 p.kill()
 
 
+# ---------------------------------------------------------------------------
+# perf-smoke-write: the write-plane CI gate (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+#: frozen like PERF_SMOKE; write-HEAVY (1:9 read:write) counter work on
+#: a small keyspace — exactly the cross-connection group-commit +
+#: commutativity-bypass path the tentpole rebuilt, with enough reads to
+#: keep the serving plane honest.  Keyspace is small on purpose: hot
+#: keys collide inside merged batches, which is the case the bypass
+#: exists for (pre-bypass they first-committer-aborted each other).
+PERF_SMOKE_WRITE = {"workers": 16, "procs": 2, "keys": 1024,
+                    "duration_s": 4, "windows": 3, "read_fraction": 0.1}
+
+
+def bench_perf_smoke_write(assert_bounds: bool, json_path=None):
+    """~30s wire smoke: write-heavy counter throughput, best-of-N
+    windows, compared against the artifact's frozen ``perf_smoke_write``
+    entry x 0.8 when ``--assert-bounds`` — the regression tripwire for
+    the merged write plane (`make perf-smoke` runs it alongside the
+    read gate; gate mode never ratchets the frozen floor)."""
+    global HOST, PORT
+    ps = PERF_SMOKE_WRITE
+    procs, info = _spawn_server(16, keys_hint=ps["keys"])
+    HOST, PORT = info["host"], info["port"]
+    try:
+        _warm_shapes(1, smoke=True)
+        # one untimed round drains ramp debt, then best-of-N windows
+        _run_workers_mp(1, ps["keys"], ps["read_fraction"], ps["workers"],
+                        3, ps["procs"])
+        pre = _pipeline_probe()
+        windows = []
+        best = (0.0, [], 0)
+        for _ in range(ps["windows"]):
+            ops, lat, workers = _run_workers_mp(
+                1, ps["keys"], ps["read_fraction"], ps["workers"],
+                ps["duration_s"], ps["procs"]
+            )
+            rate = round(ops / ps["duration_s"], 1)
+            windows.append(rate)
+            if rate > best[0]:
+                best = (rate, lat, workers)
+        pipeline = _stage_delta(pre, _pipeline_probe())
+        rate, lat, workers = best
+        out = {
+            "config": "perf_smoke_write_plane",
+            "ops_per_s": rate,
+            "windows_ops_per_s": windows,
+            "workers": workers,
+            "driver": {"rev": DRIVER_REV, **ps},
+            **_percentiles(lat),
+        }
+        if pipeline:
+            out["pipeline"] = pipeline
+        print(json.dumps(out), flush=True)
+        if assert_bounds:
+            path = json_path or "BENCH_WIRE_cpu.json"
+            with open(path) as f:
+                doc = json.load(f)
+            frozen = doc.get("perf_smoke_write", {}).get("ops_per_s")
+            assert frozen, f"no frozen perf_smoke_write entry in {path}"
+            floor = frozen * 0.8
+            assert out["ops_per_s"] >= floor, (
+                f"write throughput regressed: {out['ops_per_s']} ops/s "
+                f"< 0.8 x frozen {frozen} ops/s")
+            print(f"perf-smoke-write OK: {out['ops_per_s']} >= "
+                  f"{round(floor, 1)} (0.8 x frozen {frozen})")
+        return out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -818,6 +894,11 @@ def main():
                          "--assert-bounds, fail unless read throughput "
                          ">= 0.8 x the artifact's frozen perf_smoke "
                          "value (the `make perf-smoke` CI gate)")
+    ap.add_argument("--perf-smoke-write", action="store_true",
+                    help="~30s write-heavy north-star smoke (merged "
+                         "write plane); with --assert-bounds, fail "
+                         "unless throughput >= 0.8 x the artifact's "
+                         "frozen perf_smoke_write value")
     ap.add_argument("--assert-bounds", action="store_true",
                     help="with --saturation: fail unless goodput stays "
                          "within 20%% of peak past the knee (the `make "
@@ -849,6 +930,13 @@ def main():
             # without --assert-bounds
             _write_artifact(args.json, perf_smoke=out)
         return 0
+    if args.perf_smoke_write:
+        out = bench_perf_smoke_write(args.assert_bounds,
+                                     json_path=args.json)
+        if args.json and not args.assert_bounds:
+            # same no-ratchet discipline as the read gate
+            _write_artifact(args.json, perf_smoke_write=out)
+        return 0
     if args.saturation:
         out = bench_saturation(smoke, assert_bounds=args.assert_bounds)
         if args.json:
@@ -867,7 +955,8 @@ def main():
     return 0
 
 
-def _write_artifact(path, results=None, saturation=None, perf_smoke=None):
+def _write_artifact(path, results=None, saturation=None, perf_smoke=None,
+                    perf_smoke_write=None):
     """Merge this run into the artifact instead of clobbering it: a
     single-config or --saturation run must not erase the other frozen
     sections (results merge by config name; saturation/perf_smoke
@@ -885,6 +974,8 @@ def _write_artifact(path, results=None, saturation=None, perf_smoke=None):
         doc["saturation"] = saturation
     if perf_smoke is not None:
         doc["perf_smoke"] = perf_smoke
+    if perf_smoke_write is not None:
+        doc["perf_smoke_write"] = perf_smoke_write
     with open(path, "w") as f:
         json.dump(doc, f, indent=2)
 

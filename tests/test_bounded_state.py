@@ -98,27 +98,32 @@ def test_commit_publishes_only_touched_shards():
 
 
 def test_committed_keys_gc_bounded():
+    # register_lww assigns: NOT blind-commutative, so every commit
+    # stamps the certification table (blind counter increments take the
+    # ISSUE 6 bypass and never stamp — this test exercises the table's
+    # GC, so it needs writes that populate it)
     node = AntidoteNode(_cfg(keys_per_table=8192))
     txm = node.txm
     txm._cert_gc_every = 256
     txm._next_cert_gc = 256
     for i in range(1000):
-        node.update_objects([(f"k{i}", "counter_pn", "b", ("increment", 1))])
+        node.update_objects([(f"k{i}", "register_lww", "b",
+                              ("assign", f"v{i}"))])
     # GC fired at least thrice; all but the entries since the last floor
     # advance are gone
     assert len(txm.committed_keys) <= 2 * txm._cert_gc_every
     # correctness: first-committer-wins still aborts on a real conflict
     t1 = node.start_transaction()
-    node.update_objects([("kX", "counter_pn", "b", ("increment", 1))], t1)
-    node.update_objects([("kX", "counter_pn", "b", ("increment", 1))])
+    node.update_objects([("kX", "register_lww", "b", ("assign", "a"))], t1)
+    node.update_objects([("kX", "register_lww", "b", ("assign", "b"))])
     from antidote_tpu.txn.manager import AbortError
     with pytest.raises(AbortError):
         node.commit_transaction(t1)
     # an open txn pins the floor: entries above its snapshot survive GC
     t2 = node.start_transaction()
     for i in range(600):
-        node.update_objects([(f"pin{i}", "counter_pn", "b",
-                              ("increment", 1))])
+        node.update_objects([(f"pin{i}", "register_lww", "b",
+                              ("assign", f"p{i}"))])
     assert any(
         v > txm._open_snaps[t2.txid] for v in txm.committed_keys.values()
     )

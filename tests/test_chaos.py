@@ -799,6 +799,99 @@ def test_saturation_storm_enospc_bounded_and_converges(cfg, tmp_path):
         close_mesh(fabrics)
 
 
+def test_sigkill_mid_group_fsync_replays_exactly_acked(tmp_path):
+    """Chaos scenario 13 (ISSUE 6): SIGKILL the serving process while
+    merged commit groups from 3 connections are in flight through the
+    group-fsync plane (--sync-log --wal-segments 3).  The durability
+    contract under sync_log=true: an ACK implies the record survives
+    the kill.  Recovery must replay every acked commit, must not
+    resurrect more than was attempted (NACKed/rolled-back sub-groups
+    stay gone — the WAL truncates them; unacked in-flight appends MAY
+    survive, SIGKILL spares the page cache), and two independent
+    recoveries converge byte-identical."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log_dir = str(tmp_path / "wal")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", "2", "--max-dcs", "2",
+         "--log-dir", log_dir, "--sync-log", "--wal-segments", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True,
+    )
+    acked = [0, 0, 0]
+    attempted = [0, 0, 0]
+    errs = []
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        from antidote_tpu.proto.client import AntidoteClient
+
+        stop = threading.Event()
+
+        def writer(i):
+            # each connection hammers its own key so the merged batches
+            # at the locked worker always carry 3-way sub-groups
+            try:
+                c = AntidoteClient(info["host"], info["port"])
+                while not stop.is_set():
+                    attempted[i] += 1
+                    c.update_objects(
+                        [(f"k{i}", "counter_pn", "b", ("increment", 1))])
+                    acked[i] += 1
+            except (ConnectionError, OSError):
+                pass  # the kill severed the socket mid-request
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20.0
+        while sum(acked) < 30:  # ensure real merged traffic is flowing
+            assert time.monotonic() < deadline, f"no throughput: {acked}"
+            time.sleep(0.02)
+        time.sleep(0.3)
+        proc.send_signal(signal.SIGKILL)  # mid group-fsync, no goodbyes
+        proc.wait(timeout=10)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert all(a > 0 for a in acked), acked
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # recover twice, independently — byte-identical convergence
+    rcfg = AntidoteConfig(n_shards=2, max_dcs=2, wal_segments=3)
+    objs = [(f"k{i}", "counter_pn", "b") for i in range(3)]
+    recovered = []
+    for _ in range(2):
+        node = AntidoteNode(rcfg, log_dir=log_dir, recover=True)
+        vals, _ = node.read_objects(objs)
+        recovered.append({
+            "vals": vals,
+            "op_ids": node.store.log.op_ids.tolist(),
+            "seqs": node.store.log.seqs.tolist(),
+            "stable": [int(x) for x in node.stable_vc()],
+        })
+        node.store.log.close()
+    assert recovered[0] == recovered[1], "recoveries diverged"
+    vals = recovered[0]["vals"]
+    for i in range(3):
+        # every ACK survived the SIGKILL; nothing beyond what was sent
+        assert acked[i] <= vals[i] <= attempted[i], (
+            f"k{i}: acked={acked[i]} recovered={vals[i]} "
+            f"attempted={attempted[i]}")
+
+
 # ---------------------------------------------------------------------------
 # long soak (excluded from tier-1 via -m 'not slow'; run with `make chaos`)
 # ---------------------------------------------------------------------------

@@ -67,6 +67,8 @@ def test_certification_sees_moved_commits():
     a.update_objects([("k", "counter_pn", "bk", ("increment", 1))])
     b = AntidoteNode(cfg)
     txn = b.start_transaction()  # snapshot taken BEFORE the import
+    b.read_objects([("k", "counter_pn", "bk")], txn)  # read-bearing:
+    # a blind increment would take the ISSUE 6 commutativity bypass
     for shard in range(cfg.n_shards):
         b.receive_handoff(handoff.export_shard(a.store, shard))
     b.update_objects([("k", "counter_pn", "bk", ("increment", 10))], txn)

@@ -81,6 +81,9 @@ def test_recovery_preserves_certification(tmp_path, cfg):
     from antidote_tpu.txn.manager import Transaction
 
     stale = Transaction(np.zeros(cfg.max_dcs, np.int32))
+    # read-bearing: a blind increment would take the commutativity
+    # bypass (ISSUE 6) and legitimately skip certification
+    node2.txm.read_objects([("k", "counter_pn", "b")], stale)
     node2.txm.update_objects(
         [("k", "counter_pn", "b", ("increment", 1))], stale)
     from antidote_tpu.api import AbortError

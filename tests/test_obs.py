@@ -51,6 +51,10 @@ def test_certification_abort_counts():
     node = AntidoteNode(small_cfg())
     t1 = node.start_transaction()
     t2 = node.start_transaction()
+    # read-bearing txns keep certification (blind increments would take
+    # the ISSUE 6 commutativity bypass and both commit)
+    node.read_objects([("k", "counter_pn", "b")], t1)
+    node.read_objects([("k", "counter_pn", "b")], t2)
     node.update_objects([("k", "counter_pn", "b", ("increment", 1))], t1)
     node.update_objects([("k", "counter_pn", "b", ("increment", 1))], t2)
     node.commit_transaction(t1)

@@ -15,6 +15,7 @@ import pytest
 
 from antidote_tpu import faults
 from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.txn.manager import AbortError
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.overload import (
     AdmissionGate,
@@ -414,18 +415,22 @@ def test_log_effects_mid_group_rolls_back_prefix(tmp_path, wal_plane):
     lm.close()
 
 
-def test_enospc_mid_group_no_partial_commit_no_phantom_certs(tmp_path):
-    """Node-level mid-group ENOSPC: the whole group fails typed, recovery
-    replay resurrects NEITHER member, and the certification stamps the
-    failed group minted are rolled back (a pre-group transaction must not
-    first-committer-abort against writes that never happened)."""
+def test_enospc_mid_group_nacks_only_its_subgroup(tmp_path):
+    """Node-level mid-merged-batch ENOSPC (ISSUE 6 sub-group atomicity):
+    the sub-group whose shard file refuses the append fails TYPED and
+    rolls back alone — op-id chain, certification stamps, recovery
+    replay — while its sibling sub-group commits and stays durable.  A
+    pre-group transaction must not first-committer-abort against the
+    NACKed member's phantom stamps, but must still abort against the
+    committed sibling's real ones."""
+    import numpy as np
+
     from antidote_tpu.overload import ReadOnlyError
 
     cfg = mk_cfg()
     node = AntidoteNode(cfg, log_dir=str(tmp_path))
-    # seed a pool and find two keys on DIFFERENT shards: the group logs
-    # in txn order, so a fault scoped to the second key's shard file
-    # fails the group after the first record was appended
+    # seed a pool and find two keys on DIFFERENT shards, so a fault
+    # scoped to the second key's shard file fails exactly one sub-group
     pool = [f"k{i}" for i in range(8)]
     node.update_objects(
         [(k, "counter_pn", "b", ("increment", 1)) for k in pool])
@@ -435,38 +440,53 @@ def test_enospc_mid_group_no_partial_commit_no_phantom_certs(tmp_path):
             int(node.store.locate(k, "counter_pn", "b")[1]), k)
     assert len(by_shard) == 2, "pool never spanned both shards"
     k_first, k_second = by_shard[0], by_shard[1]
-    # a transaction whose snapshot predates the doomed group
-    pre = node.start_transaction()
-    node.update_objects([(k_first, "counter_pn", "b", ("increment", 10))],
-                        pre)
+
+    def rmw(key, amount):
+        # read-bearing: keeps certification (and its stamps) in play —
+        # blind increments would take the commutativity bypass
+        t = node.start_transaction()
+        node.read_objects([(key, "counter_pn", "b")], t)
+        node.update_objects([(key, "counter_pn", "b",
+                              ("increment", amount))], t)
+        return t
+
+    # transactions whose snapshots predate the doomed merged batch
+    pre_second = rmw(k_second, 10)
+    pre_first = rmw(k_first, 10)
     ids_before = node.store.log.op_ids.copy()
     counter_before = node.txm.commit_counter
-    t1 = node.start_transaction()
-    node.update_objects([(k_first, "counter_pn", "b", ("increment", 100))],
-                        t1)
-    t2 = node.start_transaction()
-    node.update_objects([(k_second, "counter_pn", "b", ("increment", 100))],
-                        t2)
+    t1 = rmw(k_first, 100)
+    t2 = rmw(k_second, 100)
+    shard_first = int(node.store.locate(k_first, "counter_pn", "b")[1])
     shard_second = int(node.store.locate(k_second, "counter_pn", "b")[1])
     faults.install(faults.FaultPlan(seed=5).enospc(
         "wal.append", key=f"shard_{shard_second}.wal", times=1))
-    with pytest.raises(ReadOnlyError):
-        node.txm.commit_transactions_group([t1, t2])
+    outs = node.txm.commit_transactions_group([t1, t2])
     faults.uninstall()
-    import numpy as np
-
-    assert np.array_equal(node.store.log.op_ids, ids_before)
-    assert node.txm.commit_counter == counter_before
-    # recovery probe exits read-only; the PRE-group txn commits cleanly —
-    # with stale stamps it would abort with a phantom cert conflict
+    # sibling committed, refused sub-group NACKed typed
+    assert isinstance(outs[0], np.ndarray)
+    assert isinstance(outs[1], ReadOnlyError)
+    assert node.txm.read_only_reason is not None
+    # t1's chain advanced; t2's rolled back
+    ids_after = ids_before.copy()
+    ids_after[shard_first, 0] += 1
+    assert np.array_equal(node.store.log.op_ids, ids_after)
+    # t2's counter stays a HOLE (holes are safe; nothing reuses them)
+    assert node.txm.commit_counter == counter_before + 2
+    # recovery probe exits read-only; the NACKed member's stamps are
+    # gone (pre_second commits — a phantom stamp would abort it) while
+    # the committed sibling's stamps stand (pre_first aborts)
     node.txm._ro_probe_at = 0.0
-    node.commit_transaction(pre)
+    node.commit_transaction(pre_second)
+    with pytest.raises(AbortError):
+        node.commit_transaction(pre_first)
     vals, _ = node.read_objects([(k_first, "counter_pn", "b"),
                                  (k_second, "counter_pn", "b")])
-    assert vals == [11, 1]  # the failed group's 100s never landed
+    assert vals == [101, 11]  # t1 + seeds + pre_second; t2 never landed
     node.store.log.close()
-    # replay must agree: neither group member resurrects at restart
+    # replay must agree: the committed sibling survives restart, the
+    # NACKed sub-group does not resurrect
     re = AntidoteNode(cfg, log_dir=str(tmp_path), recover=True)
     vals, _ = re.read_objects([(k_first, "counter_pn", "b"),
                                (k_second, "counter_pn", "b")])
-    assert vals == [11, 1]
+    assert vals == [101, 11]

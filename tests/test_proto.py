@@ -90,13 +90,31 @@ def test_map_rr_over_wire(client):
 
 
 def test_certification_conflict_is_remote_abort(client):
+    # read-bearing txns: blind increments would take the ISSUE 6
+    # commutativity bypass and both commit (see next test)
     t1 = client.start_transaction()
     t2 = client.start_transaction()
+    t1.read_objects([("cert", "counter_pn", "b")])
+    t2.read_objects([("cert", "counter_pn", "b")])
     t1.update_objects([("cert", "counter_pn", "b", ("increment", 1))])
     t2.update_objects([("cert", "counter_pn", "b", ("increment", 1))])
     t1.commit()
     with pytest.raises(RemoteAbort):
         t2.commit()
+
+
+def test_blind_interactive_commits_merge_without_conflict(client):
+    """Interactive BLIND commits ride the locked worker's merge point
+    and the commutativity bypass: concurrent increments to one hot key
+    all land (no first-committer aborts), and the value adds up."""
+    t1 = client.start_transaction()
+    t2 = client.start_transaction()
+    t1.update_objects([("blind", "counter_pn", "b", ("increment", 2))])
+    t2.update_objects([("blind", "counter_pn", "b", ("increment", 3))])
+    t1.commit()
+    t2.commit()
+    vals, _ = client.read_objects([("blind", "counter_pn", "b")])
+    assert vals[0] == 5
 
 
 def test_error_reply_keeps_connection(client):
@@ -218,6 +236,10 @@ def test_group_commit_abort_isolation():
     t1 = txm.start_transaction()
     t2 = txm.start_transaction()
     t3 = txm.start_transaction()
+    # t1/t2 are read-bearing (rmw) so they keep certification — blind
+    # increments would take the ISSUE 6 bypass and all commit
+    txm.read_objects([("k", "counter_pn", "b")], t1)
+    txm.read_objects([("k", "counter_pn", "b")], t2)
     txm.update_objects([("k", "counter_pn", "b", ("increment", 1))], t1)
     txm.update_objects([("k", "counter_pn", "b", ("increment", 5))], t2)
     txm.update_objects([("x", "counter_pn", "b", ("increment", 9))], t3)

@@ -65,9 +65,14 @@ def test_abort_discards_writes(node):
 
 
 def test_certification_conflict_aborts_second_txn(node):
+    """READ-BEARING (rmw) txns keep first-committer-wins; both read the
+    key before writing, so neither takes the blind-commutative bypass
+    (ISSUE 6)."""
     node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
     t1 = node.start_transaction()
     t2 = node.start_transaction()
+    node.read_objects([("k", "counter_pn", "b")], t1)
+    node.read_objects([("k", "counter_pn", "b")], t2)
     node.update_objects([("k", "counter_pn", "b", ("increment", 10))], t1)
     node.update_objects([("k", "counter_pn", "b", ("increment", 100))], t2)
     node.commit_transaction(t1)
@@ -75,6 +80,22 @@ def test_certification_conflict_aborts_second_txn(node):
         node.commit_transaction(t2)
     vals, _ = node.read_objects([("k", "counter_pn", "b")])
     assert vals == [11]
+
+
+def test_blind_commutative_writes_never_conflict(node):
+    """The ISSUE 6 certification bypass: BLIND counter increments from
+    concurrent txns commute, so none aborts and none touches the
+    certification stamp table — only the read-bearing txn above pays
+    first-committer-wins."""
+    t1 = node.start_transaction()
+    t2 = node.start_transaction()
+    node.update_objects([("k", "counter_pn", "b", ("increment", 10))], t1)
+    node.update_objects([("k", "counter_pn", "b", ("increment", 100))], t2)
+    node.commit_transaction(t1)
+    node.commit_transaction(t2)  # would first-committer-abort pre-bypass
+    vals, _ = node.read_objects([("k", "counter_pn", "b")])
+    assert vals == [110]
+    assert ("k", "b") not in node.txm.committed_keys
 
 
 def test_certification_disabled_allows_both(cfg):

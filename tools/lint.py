@@ -113,6 +113,7 @@ def check_file(path: str):
     _check_swallow_loops(tree, path, noqa, problems)
     _check_unbounded_queues(tree, path, lines, problems)
     _check_serving_syncs(path, lines, problems)
+    _check_fsync_policy(path, lines, problems)
     return problems
 
 
@@ -205,6 +206,40 @@ def _check_serving_syncs(path, lines, problems) -> None:
                     "serving hot path — move it to the writeback stage "
                     "or justify with '# sync-ok: <reason>'"
                 )
+
+
+#: the one file allowed to call os.fsync freely: the WAL owns durability
+#: (group-fsync coordinator, background syncer, commit barriers).  An
+#: os.fsync anywhere else in the package is either a policy leak (per-
+#: call fsyncs are exactly the serial floor ISSUE 6 removed) or a
+#: deliberate non-log use (atomic metadata replace, probe sidecars) that
+#: must say so with a ``# fsync-ok: <reason>`` note.
+_FSYNC_OWNER = os.path.join("antidote_tpu", "log", "wal.py")
+
+
+def _check_fsync_policy(path, lines, problems) -> None:
+    """Reject direct ``os.fsync`` outside log/wal.py without a
+    ``# fsync-ok: <reason>`` annotation on the line or within the three
+    preceding lines — the group-fsync policy stays centralized."""
+    norm = os.path.normpath(path)
+    if norm.endswith(_FSYNC_OWNER) or os.sep + "tests" + os.sep in norm \
+            or norm.startswith("tests" + os.sep) \
+            or os.path.basename(norm) == "lint.py":  # the rule's own source
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("fsync-ok:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        if "os.fsync(" in code and not annotated(i) \
+                and "fsync-ok:" not in ln:
+            problems.append(
+                f"{path}:{i}: direct os.fsync outside log/wal.py — "
+                "route durability through the WAL's group-fsync "
+                "coordinator, or justify with '# fsync-ok: <reason>'"
+            )
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
