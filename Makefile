@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -40,6 +40,15 @@ saturation:
 perf-smoke:
 	$(PY) bench_wire.py --perf-smoke --assert-bounds --json BENCH_WIRE_cpu.json
 	$(PY) bench_wire.py --perf-smoke-write --assert-bounds --json BENCH_WIRE_cpu.json
+
+# checkpointed fast-restart smoke (ISSUE 8): populates through the
+# durable commit path, SIGKILLs, measures full-replay vs checkpoint+tail
+# recovery in cold subprocesses, and asserts the STRUCTURAL gates only
+# (fast < full, byte-identical recovered state, WAL bytes reclaimed) —
+# the frozen BENCH_RESTART_cpu.json numbers are never a ratchet
+restart-smoke:
+	$(PY) tools/bench_restart.py --smoke --assert-bounds
+	$(PY) -m pytest tests/test_checkpoint.py -q
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

@@ -330,6 +330,17 @@ class ClusterMember:
                 self.applied_ts[s] = int(
                     self.node.store.applied_vc[s, self.dc_id])
             self._replay_recovered_commits(pending)
+        # checkpoint image extras (ISSUE 8): the membership + departed-id
+        # state rides in every checkpoint this member's node publishes.
+        # INFORMATIONAL in this build — the prepare log stays the
+        # authoritative ownership record at recovery (it compacts
+        # independently and re-emits the full membership state) — but it
+        # makes `console inspect-checkpoint` show who owned what at the
+        # stamp, and the durable shard-reset epoch (bumped by the
+        # relinquish path's truncate_shard) is what guarantees a shard
+        # moved AFTER a checkpoint never resurrects here from the image.
+        self.node.checkpoint_extras_providers["membership"] = (
+            self._checkpoint_membership)
         self.rpc = RpcServer(host=host)
         for name in ("m_read_values", "m_downstream", "m_prepare",
                      "m_commit", "m_abort", "m_clocks", "m_seq",
@@ -342,6 +353,21 @@ class ClusterMember:
                      "m_relinquish_shard", "m_cancel_export", "m_set_owner",
                      "m_forget_member"):
             self.rpc.register(name, getattr(self, name))
+
+    def _checkpoint_membership(self) -> dict:
+        """Membership snapshot for the checkpoint image (called under the
+        commit lock by the checkpointer's stamp barrier)."""
+        with self._lock:
+            return {
+                "member_id": int(self.member_id),
+                "n_members": int(self.n_members),
+                "shards": sorted(int(s) for s in self.shards),
+                "shard_map": {str(s): int(o)
+                              for s, o in self.shard_map.items()},
+                "shard_epoch": {str(s): int(e)
+                                for s, e in self.shard_epoch.items()},
+                "departed": sorted(int(m) for m in self.departed),
+            }
 
     @property
     def _xlock(self):
@@ -395,7 +421,8 @@ class ClusterMember:
             path = os.path.join(self._prep_dir, "prepare.wal")
             tmp = path + ".tmp"
             if os.path.exists(tmp):
-                os.remove(tmp)
+                os.remove(tmp)  # reclaim-ok: stale compaction temp from
+                # a crashed rewrite; the live prepare.wal is untouched
             w = ShardWAL(tmp, sync_on_commit=False)
             # MEMBERSHIP STATE FIRST: compaction rewrites the log from
             # live state, and without these records a post-move member

@@ -63,9 +63,14 @@ def cmd_serve(args) -> int:
     apply_jax_platform_env()
     enable_compilation_cache()
 
+    from antidote_tpu import faults as _faults
     from antidote_tpu.api import AntidoteNode
     from antidote_tpu.config import AntidoteConfig
     from antidote_tpu.proto.server import ProtocolServer
+
+    # subprocess chaos hook: the chaos suite SIGKILLs serve children and
+    # cannot install a plan in-process, so one may ride in the env
+    _faults.install_from_env()
 
     shards, max_dcs = resolve_serve_shape(args.log_dir, args.shards,
                                           args.max_dcs)
@@ -73,13 +78,24 @@ def cmd_serve(args) -> int:
                          keys_per_table=args.keys_per_table,
                          wal_segments=args.wal_segments,
                          sync_log=args.sync_log)
-    has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and any(
-        f.endswith(".wal") and os.path.getsize(os.path.join(args.log_dir, f)) > 0
-        for f in os.listdir(args.log_dir)
+    from antidote_tpu.log.checkpoint import has_checkpoints
+
+    has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and (
+        any(
+            f.endswith(".wal")
+            and os.path.getsize(os.path.join(args.log_dir, f)) > 0
+            for f in os.listdir(args.log_dir)
+        )
+        # a published checkpoint is committed data even when every WAL
+        # file below its floor was reclaimed
+        or has_checkpoints(args.log_dir)
     )
     recover = args.recover or has_wal_data
     node = AntidoteNode(cfg, dc_id=args.dc_id, log_dir=args.log_dir,
                         recover=recover)
+    if args.log_dir is not None and args.checkpoint_interval_s > 0:
+        node.start_checkpointer(interval_s=args.checkpoint_interval_s,
+                                retain=args.checkpoint_retain)
     probes = node.check_ready()
     if not all(probes.values()):
         log(f"NOT READY: {probes}")
@@ -167,6 +183,8 @@ def cmd_serve(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         log("shutting down")
+        if node.checkpointer is not None:
+            node.checkpointer.stop()
         sup.shutdown()
     return 0
 
@@ -224,7 +242,7 @@ def cmd_inspect(args) -> int:
     shards = sorted({
         int(m.group(1))
         for p in glob.glob(os.path.join(args.log_dir, "shard_*.wal"))
-        if (m := re.match(r"shard_(\d+)(\.s\d+)?\.wal$",
+        if (m := re.match(r"shard_(\d+)\.(?:s\d+\.)?(?:g\d+\.)?wal$",
                           os.path.basename(p)))
     })
     out = {}
@@ -245,6 +263,54 @@ def cmd_inspect(args) -> int:
             "segments": len(paths),
             "bytes": sum(os.path.getsize(p) for p in paths),
         }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_checkpoint_now(args) -> int:
+    """Run one synchronous checkpoint cycle on a serving node and print
+    the published manifest (stamp, image bytes, WAL bytes reclaimed)."""
+    c = _client(args)
+    print(json.dumps(c.checkpoint_now(), indent=2))
+    c.close()
+    return 0
+
+
+def cmd_inspect_checkpoint(args) -> int:
+    """Offline checkpoint inspection: every published image's manifest
+    (newest last), plus the decoded summary of the newest one — stamp
+    VC, per-shard floors, replication chain floors, tables, extras
+    (e.g. cluster membership at the stamp)."""
+    from antidote_tpu.log import checkpoint as _ckpt
+
+    root = _ckpt.checkpoint_root(args.log_dir)
+    cks = _ckpt.list_checkpoints(root)
+    out = {"root": root,
+           "published": [m for _id, p in cks
+                         if (m := _ckpt.load_manifest(p)) is not None]}
+    latest = _ckpt.load_latest(args.log_dir)
+    if latest is not None:
+        image, manifest = latest
+        out["latest"] = {
+            "id": int(image["id"]),
+            "verified": True,
+            "keys": len(image["directory"]),
+            "tables": {
+                t: int(sum(int(x) for x in tb["used_rows"]))
+                for t, tb in image["tables"].items()
+            },
+            "stamp_vc_max": manifest.get("stamp_vc_max"),
+            "commit_counter": int(image["commit_counter"]),
+            "floor_seqs": [int(x) for x in image["floor_seqs"]],
+            "chain_floor": [[int(x) for x in row]
+                            for row in image["chain_floor"]],
+            "blobs": len(image.get("blobs", [])),
+            "shard_resets": image.get("shard_resets", {}),
+            "extras": sorted((image.get("extras") or {}).keys()),
+        }
+        membership = (image.get("extras") or {}).get("membership")
+        if membership:
+            out["latest"]["membership"] = membership
     print(json.dumps(out, indent=2))
     return 0
 
@@ -392,6 +458,16 @@ def main(argv=None) -> int:
                          "sync_log=false — an ack then means 'reached "
                          "the OS', durable within the WAL's background "
                          "sync interval")
+    sv.add_argument("--checkpoint-interval-s", type=float, default=300.0,
+                    help="background checkpoint cadence (ISSUE 8): each "
+                         "cycle publishes a VC-stamped store image and "
+                         "reclaims WAL files below its floor, so restart "
+                         "= load image + replay tail.  <= 0 disables "
+                         "(restart then replays the whole WAL)")
+    sv.add_argument("--checkpoint-retain", type=int, default=2,
+                    help="published checkpoint images kept on disk; "
+                         "older ones (and WAL files wholly below the "
+                         "newest floor) are reclaimed after each publish")
     sv.add_argument("--group-commit-window-us", type=float, default=0.0,
                     help="merge-point gather window in µs: the locked "
                          "worker keeps draining late-arriving commits "
@@ -421,6 +497,22 @@ def main(argv=None) -> int:
     ins = sub.add_parser("inspect", help="offline WAL inspection")
     ins.add_argument("--log-dir", required=True)
     ins.set_defaults(fn=cmd_inspect)
+
+    cn = sub.add_parser("checkpoint-now",
+                        help="run one synchronous checkpoint cycle on a "
+                             "serving node (stamp, stream, publish, "
+                             "reclaim) and print the manifest")
+    cn.add_argument("--host", default="127.0.0.1")
+    cn.add_argument("--port", type=int, default=8087)
+    cn.set_defaults(fn=cmd_checkpoint_now)
+
+    ic = sub.add_parser("inspect-checkpoint",
+                        help="offline checkpoint inspection: published "
+                             "manifests + the newest image's decoded "
+                             "summary (stamp VC, floors, chain floors, "
+                             "membership extras)")
+    ic.add_argument("--log-dir", required=True)
+    ic.set_defaults(fn=cmd_inspect_checkpoint)
 
     # cluster membership/ops commands against a member's control RPC
     # (antidote_console staged_join/down/ringready,

@@ -28,6 +28,7 @@ paths are unaffected.
 
 from antidote_tpu.faults.plan import (
     ACTIONS,
+    PLAN_ENV,
     Decision,
     FaultInjector,
     FaultPlan,
@@ -35,11 +36,14 @@ from antidote_tpu.faults.plan import (
     get_injector,
     hit,
     install,
+    install_from_env,
     is_severed,
+    plan_from_env,
     uninstall,
 )
 
 __all__ = [
-    "ACTIONS", "Decision", "FaultInjector", "FaultPlan", "FaultRule",
-    "get_injector", "hit", "install", "is_severed", "uninstall",
+    "ACTIONS", "PLAN_ENV", "Decision", "FaultInjector", "FaultPlan",
+    "FaultRule", "get_injector", "hit", "install", "install_from_env",
+    "is_severed", "plan_from_env", "uninstall",
 ]

@@ -99,6 +99,24 @@ class FaultPlan:
                                                        sync window; error/
                                                        enospc/io_error fail
                                                        the covering ticket)
+    wal.truncate_below  WAL file basename              checkpoint reclaim
+                                                       (delay holds the
+                                                       deleter mid-pass;
+                                                       error aborts it —
+                                                       retried next ckpt)
+    ckpt.write          checkpoint name (ckpt_N)       image stream (per
+                                                       chunk: delay holds
+                                                       the writer mid-
+                                                       stream; enospc/
+                                                       io_error abort the
+                                                       attempt, publishing
+                                                       and truncating
+                                                       nothing)
+    ckpt.fsync          checkpoint name                image fsync (rides
+                                                       the group-fsync
+                                                       coordinator)
+    ckpt.rename         checkpoint name                atomic publish
+                                                       rename
     native_pump.load    None                           native receive plane
     ==================  =============================  =================
     """
@@ -240,6 +258,48 @@ class FaultInjector:
         _, restart = self._endpoints[name]
         log.info("faults: restarting endpoint %r", name)
         restart()
+
+
+#: env var carrying a JSON fault plan for SUBPROCESS chaos: entrypoints
+#: that cannot be reached by an in-process ``install`` (console serve
+#: children the chaos suite SIGKILLs) arm it at boot via
+#: :func:`install_from_env`.  Shape:
+#:   {"seed": 7, "rules": [{"site": "ckpt.write", "action": "delay",
+#:                          "key": null, "p": 1.0, "times": null,
+#:                          "arg": 0.05}, ...]}
+PLAN_ENV = "ANTIDOTE_FAULT_PLAN"
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Parse :data:`PLAN_ENV` into a FaultPlan (None when unset).  A
+    malformed spec raises — a chaos run silently proceeding WITHOUT its
+    faults would green-light untested behavior."""
+    import json
+    import os
+
+    raw = os.environ.get(PLAN_ENV)
+    if not raw:
+        return None
+    spec = json.loads(raw)
+    plan = FaultPlan(seed=int(spec.get("seed", 0)))
+    for r in spec.get("rules", []):
+        key = r.get("key")
+        if isinstance(key, list):
+            key = tuple(key)
+        plan.add(r["site"], r["action"], key=key,
+                 p=float(r.get("p", 1.0)), times=r.get("times"),
+                 arg=r.get("arg"))
+    return plan
+
+
+def install_from_env() -> Optional[FaultInjector]:
+    """Arm the env-declared plan, if any (subprocess chaos hook)."""
+    plan = plan_from_env()
+    if plan is None:
+        return None
+    log.warning("arming fault plan from %s: %d rule(s), seed %d",
+                PLAN_ENV, len(plan.rules), plan.seed)
+    return install(plan)
 
 
 # ---------------------------------------------------------------------------

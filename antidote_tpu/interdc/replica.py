@@ -150,7 +150,8 @@ class DCReplica:
     # ------------------------------------------------------------------
     # restart (check_node_restart, /root/reference/src/inter_dc_manager.erl:156-206)
     # ------------------------------------------------------------------
-    def _wal_txn_groups(self, shard: int, my_effects_after: int = 0):
+    def _wal_txn_groups(self, shard: int, my_effects_after: int = 0,
+                        snap: "Tuple[int, int] | None" = None):
         """One shard's WAL records grouped into transactions, in apply
         order.  Grouping key is the (origin, commit VC) IDENTITY over the
         whole replay — commit VCs are unique per origin — never record
@@ -159,16 +160,29 @@ class DCReplica:
         desync the opid chain (r1 advisor medium (c)).
 
         Returns [[origin, vc_tuple, effects]].  Effects are materialized
-        only for my own chain and only for groups whose 1-based chain
-        opid exceeds ``my_effects_after`` — a catch-up query slightly
-        below the window must not pay effect decoding for the whole chain
-        prefix it will discard."""
+        only for my own chain and only for groups whose chain opid
+        exceeds ``my_effects_after`` — a catch-up query slightly below
+        the window must not pay effect decoding for the whole chain
+        prefix it will discard.  My-chain opids are numbered from the
+        log's CHAIN FLOOR (ISSUE 8): a checkpoint-truncated WAL holds
+        only the tail groups, and the floor records how many own-origin
+        groups the image covers, so numbering stays continuous across
+        compaction."""
         store = self.node.store
         index: Dict[Tuple[int, tuple], int] = {}
         out: List[list] = []
         my_opid: Dict[int, int] = {}
-        my_count = 0
-        for rec in store.log.replay_shard(shard):
+        # (base, floor) snapshot under the commit lock: a checkpoint
+        # publish updates both together there, and a torn read would
+        # shift this response's opid numbering against the chain.
+        # Callers that ALSO number against the base (catch-up serving)
+        # pass their own snapshot so both sides agree.
+        if snap is None:
+            with self.node.txm.commit_lock:
+                snap = (store.log.chain_base(shard, self.dc_id),
+                        int(store.log.floor_seqs[shard]))
+        my_count, floor = snap
+        for rec in store.log.replay_shard(shard, floor=floor):
             ident = (int(rec["o"]), tuple(int(x) for x in rec["vc"]))
             at = index.get(ident)
             if at is None:
@@ -219,7 +233,17 @@ class DCReplica:
         store = self.node.store
         assert store.log is not None, "restore_from_log needs a WAL"
         for shard in sorted(self.shards):
+            # chain positions resume at the checkpoint's chain floor
+            # (groups the image covers but the truncated WAL no longer
+            # holds) + whatever the tail replays on top; with no
+            # checkpoint the floors are zero and this is the classic
+            # whole-log reseed
             counts: Dict[int, int] = collections.defaultdict(int)
+            for origin in range(self.node.cfg.max_dcs):
+                base = store.log.chain_base(shard, origin)
+                if base:
+                    counts[origin] = base
+            self.pub_opid[shard] = store.log.chain_base(shard, self.dc_id)
             for origin, vc, effs in self._wal_txn_groups(shard):
                 counts[origin] += 1
                 if origin != self.dc_id:
@@ -260,9 +284,12 @@ class DCReplica:
             opid = int(extras["pub_opid"])
         elif self.node.store.log is not None:
             # count my own-origin txn groups in the (just-imported) WAL
-            # chain; a huge my_effects_after skips effect materialization
-            opid = sum(1 for origin, _vc, _effs in self._wal_txn_groups(
-                shard, my_effects_after=1 << 62) if origin == self.dc_id)
+            # chain on top of any compaction-floor base; a huge
+            # my_effects_after skips effect materialization
+            opid = self.node.store.log.chain_base(shard, self.dc_id) + sum(
+                1 for origin, _vc, _effs in self._wal_txn_groups(
+                    shard, my_effects_after=1 << 62)
+                if origin == self.dc_id)
         else:
             opid = 0  # WAL-less + extras-less: test-only configuration
         # MONOTONE: adopt_shard re-runs on duplicate import deliveries
@@ -572,10 +599,27 @@ class DCReplica:
                 ]
             window_start = window[0].prev_opid
         if self.node.store.log is not None:
+            wlog = self.node.store.log
+            with self.node.txm.commit_lock:
+                base = wlog.chain_base(shard, self.dc_id)
+                floor_snap = (base, int(wlog.floor_seqs[shard]))
+            if from_opid < base:
+                # the requested prefix was checkpoint-compacted away:
+                # serving from base would leave an unfillable gap at the
+                # subscriber (its chain check only accepts contiguous
+                # opids), so refuse loudly — the operator remedy is a
+                # fresh subscription / state transfer, and the
+                # prevention is retention sized above the slowest peer
+                raise RuntimeError(
+                    f"catch-up from opid {from_opid} on shard {shard} is "
+                    f"below the compaction floor ({base}): that chain "
+                    "prefix was checkpoint-truncated and only lives in "
+                    "the checkpoint image"
+                )
             out = []
-            opid = 0
+            opid = base
             for origin_g, vc, effs in self._wal_txn_groups(
-                shard, my_effects_after=from_opid
+                shard, my_effects_after=from_opid, snap=floor_snap
             ):
                 if origin_g != self.dc_id:
                     continue

@@ -16,6 +16,7 @@ import glob as _glob
 import json
 import os
 import re
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import msgpack
@@ -30,10 +31,12 @@ from antidote_tpu.log.wal import (
     ready_ticket,
     replay,
     replay_segments,
+    wholly_below,
 )
 
 __all__ = ["LogManager", "SegmentedShardWAL", "ShardWAL", "FsyncTicket",
-           "replay", "replay_segments", "shard_segment_paths"]
+           "replay", "replay_segments", "shard_segment_paths",
+           "gen_segment_paths", "wholly_below"]
 
 _META_FILE = "antidote_meta.json"
 
@@ -160,8 +163,9 @@ def shard_segment_paths(directory: str, shard: int,
                         n_segments: int = 1) -> List[str]:
     """Every segment file a shard's records may live in: the configured
     segment set UNION whatever extra ``shard_P.sN.wal`` files exist on
-    disk — a directory written with more segments and opened with fewer
-    must still replay everything."""
+    disk (including checkpoint-generation files ``shard_P.sN.gG.wal``) —
+    a directory written with more segments (or a different generation)
+    and opened with fewer must still replay everything."""
     paths = [os.path.join(directory, f"shard_{shard}.wal")] + [
         os.path.join(directory, f"shard_{shard}.s{i}.wal")
         for i in range(1, max(1, n_segments))
@@ -171,6 +175,23 @@ def shard_segment_paths(directory: str, shard: int,
         - set(paths)
     )
     return paths + extra
+
+
+def gen_segment_paths(directory: str, shard: int, n_segments: int,
+                      gen: int) -> List[str]:
+    """The ACTIVE segment file set of one shard at checkpoint generation
+    ``gen``.  Generation 0 is the classic layout (``shard_P.wal`` +
+    ``shard_P.sN.wal``); each checkpoint stamp rotates every shard onto a
+    fresh generation's files (``shard_P.sN.gG.wal``), freezing the old
+    ones so the post-publish reclaim can delete them wholesale once their
+    records are covered by the image."""
+    if gen == 0:
+        return shard_segment_paths(directory, shard,
+                                   n_segments)[:max(1, n_segments)]
+    return [
+        os.path.join(directory, f"shard_{shard}.s{i}.g{gen}.wal")
+        for i in range(max(1, n_segments))
+    ]
 
 
 class SegmentedShardWAL:
@@ -188,6 +209,7 @@ class SegmentedShardWAL:
     def __init__(self, directory: str, shard: int, n_segments: int = 1,
                  sync_on_commit: bool = False):
         self.shard = shard
+        self.dir = directory
         self.n_segments = max(1, int(n_segments))
         self.segs = [
             ShardWAL(p, sync_on_commit=sync_on_commit)
@@ -195,6 +217,22 @@ class SegmentedShardWAL:
                                          self.n_segments)[:self.n_segments]
         ]
         self._cur = 0
+
+    def swap_generation(self, gen: int) -> List[ShardWAL]:
+        """Rotate onto generation ``gen``'s fresh segment files (the
+        checkpoint stamp's WAL barrier: all records appended so far stay
+        in the now-frozen old files, every later record lands in the new
+        ones).  Caller must hold the commit lock — no append may race
+        the swap.  Returns the retired segments; the caller drains the
+        fsync coordinator before closing them."""
+        old = self.segs
+        self.segs = [
+            ShardWAL(p, sync_on_commit=self.sync_on_commit)
+            for p in gen_segment_paths(self.dir, self.shard,
+                                       self.n_segments, gen)
+        ]
+        self._cur = 0
+        return old
 
     @property
     def current(self) -> ShardWAL:
@@ -259,6 +297,32 @@ class LogManager:
         #: per-shard append sequence — total order across a shard's
         #: segments (stamped as ``"q"``; recovery merges by it)
         self.seqs = np.zeros(cfg.n_shards, np.int64)
+        # --- checkpoint floors (ISSUE 8) -------------------------------
+        #: per-shard append-sequence floor: records with q ≤ floor are
+        #: covered by the loaded/published checkpoint image and are
+        #: SKIPPED by every replay (they may or may not still exist on
+        #: disk — reclaim deletes whole files once all their records are
+        #: below the floor, so presence is never load-bearing)
+        self.floor_seqs = np.zeros(cfg.n_shards, np.int64)
+        #: per-(shard, origin) count of replication txn GROUPS below the
+        #: floor — the base the inter-DC chain positions resume from
+        #: (pub_opid for the own lane, last_seen for remote lanes); a
+        #: catch-up below this base is below the compaction horizon
+        self.chain_floor = np.zeros((cfg.n_shards, cfg.max_dcs), np.int64)
+        #: active checkpoint generation: each checkpoint stamp rotates
+        #: every shard onto generation-suffixed segment files so the old
+        #: ones freeze and become deletable wholesale after publish
+        self.gen = 0
+        #: rotated-out segments awaiting the post-publish drain + close
+        self._retired: List[ShardWAL] = []
+        #: per-shard truncation epoch (durable in antidote_meta.json):
+        #: bumped by truncate_shard so a checkpoint image written BEFORE
+        #: a shard was relinquished can never resurrect it at recovery
+        meta = load_dir_meta(directory) or {}
+        self.shard_resets: Dict[int, int] = {
+            int(k): int(v)
+            for k, v in (meta.get("shard_resets") or {}).items()
+        }
         #: blob handles already persisted per shard (avoid re-writing bytes)
         self._blob_seen = [set() for _ in range(cfg.n_shards)]
         #: group-fsync coordinator: commit barriers under sync_log=true
@@ -457,34 +521,159 @@ class LogManager:
         for w in self.wals:
             w.probe()
 
+    # ------------------------------------------------------------------
+    # checkpoint floors & truncation (ISSUE 8)
+    # ------------------------------------------------------------------
+    def chain_base(self, shard: int, origin: int) -> int:
+        """Replication txn groups below the compaction floor for one
+        (shard, origin) chain — where opid/last_seen numbering resumes."""
+        return int(self.chain_floor[shard, origin])
+
+    def set_floor(self, floors, chain_floor) -> None:
+        """Install a checkpoint's per-shard floors: every replay from now
+        on skips records at or below them (they are covered by the
+        image).  Caller holds the commit lock when the store is live."""
+        self.floor_seqs = np.asarray(floors, np.int64).copy()
+        self.chain_floor = np.asarray(chain_floor, np.int64).copy()
+        # fresh appends must mint sequences above everything the image
+        # covers even before any tail record is replayed
+        np.maximum(self.seqs, self.floor_seqs, out=self.seqs)
+
+    def rotate_generation(self) -> List[ShardWAL]:
+        """Swap every shard onto a fresh segment-file generation (the
+        checkpoint stamp's WAL barrier).  Caller must hold the commit
+        lock.  The retired segments are queued for the post-publish
+        drain+close in :meth:`reclaim_below`; returns them for tests."""
+        self.gen += 1
+        out: List[ShardWAL] = []
+        for w in self.wals:
+            out.extend(w.swap_generation(self.gen))
+        self._retired.extend(out)
+        return out
+
+    def set_chain_floor(self, shard: int, counts) -> None:
+        """Install one shard's replication-group base counts (handoff
+        from a compacted source: the package carries the source's chain
+        floor so the importer's WAL-derived opid numbering continues the
+        true chain instead of restarting at the tail count)."""
+        self.chain_floor[shard] = np.maximum(
+            self.chain_floor[shard], np.asarray(counts, np.int64))
+
+    def drain_retired(self) -> None:
+        """Drain the group-fsync coordinator and close rotated-out
+        segment handles.  Runs after a publish (reclaim) AND after a
+        FAILED checkpoint attempt — repeated failures must not
+        accumulate open fds (sync on a closed segment is a no-op, so a
+        straggler barrier that raced the rotation stays safe; the files
+        themselves stay on disk until a published floor covers them)."""
+        retired, self._retired = self._retired, []
+        if not retired:
+            return
+        try:
+            self._fsync.submit(list(retired)).wait()
+        except Exception:
+            pass  # frozen files owe no further durability here
+        for s in retired:
+            s.close()
+
+    def reclaim_below(self, floors) -> int:
+        """Delete WAL files wholly covered by a PUBLISHED checkpoint
+        (every record's append sequence ≤ the shard's floor, verified by
+        scan — the guarded truncation API; nothing in this package may
+        raw-unlink a WAL file).  Active segments are never candidates.
+        Returns bytes reclaimed.  Crash-safe at any point: deletion only
+        removes records every replay already skips via the floor filter,
+        so a SIGKILL mid-reclaim leaves a byte-identical recovery."""
+        from antidote_tpu import faults as _faults
+
+        floors = np.asarray(floors, np.int64)
+        self.drain_retired()
+        reclaimed = 0
+        for shard in range(self.cfg.n_shards):
+            floor = int(floors[shard])
+            if floor <= 0:
+                continue
+            active = set(gen_segment_paths(self.dir, shard,
+                                           self.n_segments, self.gen))
+            for path in shard_segment_paths(self.dir, shard,
+                                            self.n_segments):
+                if path in active or not os.path.exists(path):
+                    continue
+                d = _faults.hit("wal.truncate_below",
+                                key=os.path.basename(path))
+                if d is not None:
+                    if d.action == "delay" and d.arg:
+                        time.sleep(float(d.arg))
+                    elif d.action in ("error", "io_error", "enospc"):
+                        raise IOError(
+                            f"injected fault: wal.truncate_below {path}")
+                if not wholly_below(path, floor):
+                    continue  # still carries post-floor records
+                size = os.path.getsize(path)
+                os.remove(path)  # reclaim-ok: guarded — scan proved every
+                # record ≤ the published checkpoint floor
+                reclaimed += size
+        return reclaimed
+
     def truncate_shard(self, shard: int) -> None:
-        """Discard one shard's log — ALL its segments (post-handoff
-        cleanup: the records now live in the receiver's chain).  Resets
-        the shard's op-id chains, append sequence and blob-dedup memory
-        along with the files."""
+        """Discard one shard's log — ALL its segments, including frozen
+        checkpoint generations (post-handoff cleanup: the records now
+        live in the receiver's chain).  Resets the shard's op-id chains,
+        append sequence, compaction floors and blob-dedup memory along
+        with the files, and durably bumps the shard's truncation epoch
+        so a checkpoint image written before this call can never
+        resurrect the relinquished shard at recovery."""
         sync = self.wals[shard].sync_on_commit
         self.wals[shard].close()
+        # retired (previous-generation) segments of THIS shard lose their
+        # files below; close them now and forget them
+        prefix = os.path.join(self.dir, f"shard_{shard}.")
+        for s in [s for s in self._retired if s.path.startswith(prefix)]:
+            s.close()
+            self._retired.remove(s)
         for path in shard_segment_paths(self.dir, shard, self.n_segments):
             if os.path.exists(path):
-                os.remove(path)
+                os.remove(path)  # reclaim-ok: whole-shard handoff drop —
+                # the records live on at the new owner
         self.wals[shard] = SegmentedShardWAL(
             self.dir, shard, self.n_segments, sync_on_commit=sync
         )
+        if self.gen:
+            for s in self.wals[shard].swap_generation(self.gen):
+                s.close()
         self.op_ids[shard] = 0
         self.seqs[shard] = 0
+        self.floor_seqs[shard] = 0
+        self.chain_floor[shard] = 0
         self._blob_seen[shard].clear()
+        self.shard_resets[shard] = self.shard_resets.get(shard, 0) + 1
+        _set_dir_meta_key(self.dir, "shard_resets",
+                          {str(k): v for k, v in self.shard_resets.items()})
 
-    def replay_shard(self, shard: int) -> Iterator[dict]:
+    def replay_shard(self, shard: int,
+                     floor: Optional[int] = None) -> Iterator[dict]:
         """Replay one shard's records in exact append order, merged
-        across its segments by the ``"q"`` sequence.  Side effect: the
+        across its segments by the ``"q"`` sequence.  Records at or
+        below the shard's checkpoint floor are SKIPPED — they are
+        covered by the checkpoint image (whether their file was already
+        reclaimed or not), so recovery is load-image + this tail.
+        Legacy records (no ``"q"``) predate any checkpoint and are
+        skipped whenever a floor is set.  ``floor`` overrides the live
+        one — callers that pair it with :meth:`chain_base` (catch-up
+        serving on fabric threads) snapshot both under the commit lock
+        so a concurrent publish can't split them.  Side effect: the
         shard's append-sequence counter resumes past every replayed
         record, so a recovered node's fresh appends never reuse a
         sequence (recovery always replays every shard)."""
+        if floor is None:
+            floor = int(self.floor_seqs[shard])
         for rec in replay_segments(
                 shard_segment_paths(self.dir, shard, self.n_segments)):
             q = rec.get("q")
             if q is not None and q > self.seqs[shard]:
                 self.seqs[shard] = int(q)
+            if floor and (q is None or int(q) <= floor):
+                continue
             yield rec
 
     def replay_key(self, shard: int, key, bucket: str) -> List[dict]:
@@ -499,5 +688,8 @@ class LogManager:
 
     def close(self) -> None:
         self._fsync.close()
+        for s in self._retired:
+            s.close()
+        self._retired = []
         for w in self.wals:
             w.close()

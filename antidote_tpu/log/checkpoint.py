@@ -1,0 +1,732 @@
+"""Checkpointed fast restart: VC-stamped epoch snapshots of the whole
+store, WAL tail truncation, crash-safe compaction (ISSUE 8).
+
+The reference treats "the op log IS the checkpoint" (``recover_from_log``)
+and bounds it only by pruning ops below the min cached snapshot
+(``prune_ops``, SURVEY §2.3).  This module lifts that idea to the store
+level: a background checkpointer streams an atomically-published image of
+the store — per-table frozen heads (the same immutable buffers the
+serving-epoch plane gathers from), slot-tier metadata, the directory,
+blob payloads, op-id chains, certification stamps, commit counters, and
+the inter-DC chain positions — stamped with the applied vector clock and
+each shard's WAL append sequence ``q`` (the *floor*).  Recovery becomes
+load-image + heap-merge replay of only the WAL tail above the floor, and
+WAL files wholly below the floor are reclaimed through a guarded API
+(:meth:`~antidote_tpu.log.LogManager.reclaim_below` — never a raw
+unlink), which is what bounds WAL growth under a sustained write storm.
+
+Crash safety contract: a SIGKILL at ANY point — mid-stream, mid-rename,
+mid-truncation — recovers byte-identical to a never-checkpointed replay.
+The mechanics:
+
+  * the stamp is captured under the commit lock (a short barrier: device
+    head copies are *dispatched* there, materialized outside), so the
+    image is a consistent cut: every WAL record with ``q ≤ floor`` is in
+    the image, every record above it is not;
+  * the image is written to a temp dir, fsynced THROUGH the group-fsync
+    coordinator (checkpointing never adds a second fsync stream to the
+    commit path), and published by one atomic directory rename;
+  * replay always skips records at or below the installed floor, so
+    whether a below-floor file was already deleted, half-deleted, or
+    still present changes nothing;
+  * reclaim runs only after publish, deletes only whole files whose
+    every record a scan proves ≤ floor, and a checkpoint failure
+    (ENOSPC mid-image) aborts BEFORE the floor moves — nothing is
+    truncated and the store never flips read-only because of it.
+
+Fault sites (chaos suite): ``ckpt.write``, ``ckpt.fsync``,
+``ckpt.rename`` here, ``wal.truncate_below`` in the reclaim API.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import logging
+import os
+import re
+import shutil
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from antidote_tpu import faults
+from antidote_tpu.log.wal import replay_segments
+
+log = logging.getLogger(__name__)
+
+#: subdirectory of the log dir holding published images
+CKPT_DIR = "checkpoints"
+#: published checkpoint directory name
+_CKPT_RE = re.compile(r"ckpt_(\d+)$")
+#: image stream chunk (each chunk consults the ckpt.write fault site, so
+#: chaos delays can hold the writer mid-stream)
+_CHUNK = 8 << 20
+
+_IMAGE = "image.bin"
+_MANIFEST = "manifest.json"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint attempt failed (nothing was published or truncated;
+    the store's durability state is untouched)."""
+
+
+def checkpoint_root(log_dir: str) -> str:
+    return os.path.join(log_dir, CKPT_DIR)
+
+
+def has_checkpoints(log_dir: str) -> bool:
+    """True when the directory holds at least one published checkpoint —
+    such a dir carries committed data even if every WAL file was
+    reclaimed, so boot paths must demand ``recover=True`` for it."""
+    return bool(list_checkpoints(checkpoint_root(log_dir)))
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """Published (id, path) pairs, oldest first.  A directory without a
+    readable manifest is not published (a crash mid-write leaves only
+    ``tmp.*`` dirs, which never match)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _CKPT_RE.fullmatch(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if os.path.exists(os.path.join(path, _MANIFEST)):
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def load_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def load_latest(log_dir: str) -> Optional[Tuple[dict, dict]]:
+    """Newest checkpoint whose image verifies (size + CRC against its
+    manifest), or None.  A corrupt newest image falls back to the next
+    older one — the retention window is the recovery safety margin."""
+    from antidote_tpu.store.handoff import unpack
+
+    for id_, path in reversed(list_checkpoints(checkpoint_root(log_dir))):
+        manifest = load_manifest(path)
+        if manifest is None:
+            continue
+        try:
+            with open(os.path.join(path, _IMAGE), "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        if (len(data) != int(manifest.get("image_bytes", -1))
+                or (zlib.crc32(data) & 0xFFFFFFFF)
+                != int(manifest.get("image_crc32", -1))):
+            log.warning("checkpoint %s fails verification; falling back "
+                        "to an older image", path)
+            continue
+        try:
+            image = unpack(data)
+        except Exception:
+            log.warning("checkpoint %s image undecodable; falling back",
+                        path)
+            continue
+        return image, manifest
+    return None
+
+
+# ---------------------------------------------------------------------------
+# image install (recovery side)
+# ---------------------------------------------------------------------------
+def install_image(store, txm, image: dict) -> dict:
+    """Install a checkpoint image into a FRESH store/txn-manager pair
+    (the recovery fast path's first phase; the caller replays the WAL
+    tail afterwards — :meth:`LogManager.replay_shard` already skips
+    everything the installed floor covers).
+
+    Shards whose durable truncation epoch (``antidote_meta.json``
+    ``shard_resets``, bumped by every ``truncate_shard``) advanced past
+    the image's are DROPPED: a shard relinquished to another owner after
+    the checkpoint was written must not resurrect here.  Returns a
+    summary dict (keys, tables, dropped shards).
+    """
+    from antidote_tpu.store.kv import freeze_key
+
+    import jax.numpy as jnp
+
+    logm = store.log
+    assert logm is not None, "checkpoint install needs the durable log"
+    cfg = store.cfg
+    if (int(image["n_shards"]) != cfg.n_shards
+            or int(image["max_dcs"]) != cfg.max_dcs):
+        raise CheckpointError(
+            f"checkpoint image shape (n_shards={image['n_shards']}, "
+            f"max_dcs={image['max_dcs']}) does not match the deployment "
+            f"({cfg.n_shards}, {cfg.max_dcs})"
+        )
+    image_resets = {int(k): int(v)
+                    for k, v in (image.get("shard_resets") or {}).items()}
+    stale = sorted(
+        s for s in range(cfg.n_shards)
+        if logm.shard_resets.get(s, 0) > image_resets.get(s, 0)
+    )
+    stale_set = set(stale)
+    if stale:
+        log.warning("checkpoint image predates truncation of shard(s) %s "
+                    "(moved/relinquished after the stamp); dropping them "
+                    "from the restore", stale)
+    floors = np.asarray(image["floor_seqs"], np.int64).copy()
+    chains = np.asarray(image["chain_floor"], np.int64).copy()
+    op_ids = np.asarray(image["op_ids"], np.int64).copy()
+    stamp = np.asarray(image["stamp_vc"], np.int32).copy()
+    for s in stale:
+        floors[s] = 0
+        chains[s] = 0
+        op_ids[s] = 0
+        stamp[s] = 0
+    n_rows_installed = 0
+    for tname, tb in image["tables"].items():
+        t = store.table(tname)
+        used = np.asarray(tb["used_rows"], np.int64).copy()
+        for s in stale:
+            used[s] = 0
+        head_vc = np.asarray(tb["head_vc"], np.int32).copy()
+        u_cap = head_vc.shape[1]
+        head = {f: np.asarray(x).copy() for f, x in tb["head"].items()}
+        slots_ub = np.asarray(tb["slots_ub"], np.int32).copy()
+        for s in stale:
+            head_vc[s] = 0
+            slots_ub[s] = 0
+            for f in head:
+                head[f][s] = 0
+        while u_cap > t.n_rows:
+            t._grow()
+
+        # assemble full-extent arrays HOST-side and ship each in one
+        # transfer: the store is fresh (all-zero tables), so building
+        # zeros + one slice assign + one copying transfer replaces an
+        # eager .at[].set dispatch PER ARRAY (each of which copies the
+        # whole destination — the measured majority of install time at
+        # 1M).  copy=True matters: jnp.asarray may ZERO-COPY alias the
+        # host buffer on CPU, and a later donating kernel (the append
+        # head fold) would then recycle memory the table still reads —
+        # observed as pointer garbage in element lanes under the
+        # persistent compile cache.
+        def place(host_arr):
+            out = jnp.array(host_arr, copy=True)
+            if t.sharding is not None:
+                import jax
+
+                out = jax.device_put(out, t.sharding)
+            return out
+
+        def full(dst, src, snap_slot=False):
+            arr = np.zeros(dst.shape, np.dtype(dst.dtype))
+            if snap_slot:
+                arr[:, :u_cap, 0] = src
+            else:
+                arr[:, :u_cap] = src
+            return place(arr)
+
+        for f in t.head:
+            t.head[f] = full(t.head[f], head[f])
+            # seed ONE snapshot version from the restored head: versioned
+            # reads at clocks ≥ a row's head_vc fold the (empty) ring on
+            # this base exactly; reads below it come out "incomplete" and
+            # surface the compaction horizon instead of silently missing
+            # the pre-checkpoint ops the WAL no longer holds
+            t.snap[f] = full(t.snap[f], head[f], snap_slot=True)
+        t.head_vc = full(t.head_vc, head_vc)
+        t.snap_vc = full(t.snap_vc, head_vc, snap_slot=True)
+        seq_col = (np.arange(u_cap)[None, :]
+                   < used[:, None]).astype(np.int64)
+        t.snap_seq = full(t.snap_seq, seq_col, snap_slot=True)
+        t.next_seq = 2
+        t.used_rows[:] = used
+        t.slots_ub[:, :u_cap] = slots_ub
+        t.max_abs_delta = int(tb["max_abs_delta"])
+        if stale:
+            # a dropped shard may have held the table-wide max commit VC;
+            # an inflated cap would let a serving epoch claim coverage of
+            # commits that never restored — recompute from survivors
+            mcv = head_vc.reshape(-1, head_vc.shape[-1]).max(axis=0) \
+                if head_vc.size else np.zeros(cfg.max_dcs, np.int32)
+            t.max_commit_vc = mcv.astype(np.int32)
+        else:
+            t.max_commit_vc = np.asarray(tb["max_commit_vc"],
+                                         np.int32).copy()
+        n_rows_installed += int(used.sum())
+    directory = image["directory"]
+    if stale_set:
+        directory = [e for e in directory if int(e[3]) not in stale_set]
+    n_keys = len(directory)
+    if directory:
+        # columnar zip build: C-speed tuple pairing for the (vastly
+        # common) scalar-key case; only list keys (composite map keys,
+        # tuple keys through msgpack) pay a freeze pass
+        keys, buckets, tnames, shards, rows = zip(*directory)
+        if any(type(k) is list for k in keys):
+            keys = tuple(freeze_key(k) for k in keys)
+        store.directory.update(
+            zip(zip(keys, buckets), zip(tnames, shards, rows)))
+    for h, data in image.get("blobs", []):
+        store.blobs.intern_bytes(int(h), bytes(data))
+    for s, hashes in enumerate(image.get("blob_seen", [])):
+        if s < cfg.n_shards and s not in stale_set:
+            logm._blob_seen[s] = {int(h) for h in hashes}
+    np.maximum(store.applied_vc, stamp, out=store.applied_vc)
+    np.maximum(logm.op_ids, op_ids, out=logm.op_ids)
+    logm.set_floor(floors, chains)
+    committed = image.get("committed_keys", [])
+    if committed and not stale_set and not txm.committed_keys:
+        # fresh manager, nothing dropped: bulk build (the per-entry
+        # max/membership checks below cost ~1 s per million stamps)
+        ck, cb, cv = zip(*committed)
+        if any(type(k) is list for k in ck):
+            ck = tuple(freeze_key(k) for k in ck)
+        txm.committed_keys.update(zip(zip(ck, cb), cv))
+    else:
+        for key, bucket, counter in committed:
+            dk = (freeze_key(key), bucket)
+            if dk in store.directory:
+                txm.committed_keys[dk] = max(
+                    txm.committed_keys.get(dk, 0), int(counter)
+                )
+    return {
+        "id": int(image["id"]),
+        "keys": n_keys,
+        "rows": n_rows_installed,
+        "tables": len(image["tables"]),
+        "dropped_shards": stale,
+    }
+
+
+# ---------------------------------------------------------------------------
+# checkpoint writer
+# ---------------------------------------------------------------------------
+class _ImageFsync:
+    """Adapter letting the checkpoint image ride the WAL's group-fsync
+    coordinator (one fsync stream for the whole process; a checkpoint
+    fsync coalesces with commit-barrier fsyncs instead of competing)."""
+
+    def __init__(self, fileno: int, name: str):
+        self._fileno = fileno
+        self._name = name
+
+    def sync(self) -> None:
+        d = faults.hit("ckpt.fsync", key=self._name)
+        if d is not None:
+            if d.action == "delay" and d.arg:
+                time.sleep(float(d.arg))
+            elif d.action in ("error", "io_error", "enospc"):
+                err = errno.ENOSPC if d.action == "enospc" else errno.EIO
+                raise OSError(err, f"injected fault: ckpt.fsync {self._name}")
+        os.fsync(self._fileno)  # fsync-ok: checkpoint image durability —
+        # routed through the group-fsync coordinator (see submit site)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)  # fsync-ok: directory entry durability for the
+        # atomic checkpoint publish (rename is only durable with it)
+    finally:
+        os.close(fd)
+
+
+def _faulted_write(f, data: bytes, name: str) -> None:
+    """Stream ``data`` in chunks, consulting the ``ckpt.write`` fault
+    site per chunk (delay rules hold the writer mid-stream so chaos can
+    SIGKILL inside the window; enospc/io_error abort the attempt)."""
+    view = memoryview(data)
+    for off in range(0, max(len(view), 1), _CHUNK):
+        d = faults.hit("ckpt.write", key=name)
+        if d is not None:
+            if d.action == "delay" and d.arg:
+                time.sleep(float(d.arg))
+            elif d.action == "enospc":
+                raise OSError(errno.ENOSPC,
+                              f"injected fault: ckpt.write {name}")
+            elif d.action in ("error", "io_error"):
+                raise OSError(errno.EIO,
+                              f"injected fault: ckpt.write {name}")
+        f.write(view[off:off + _CHUNK])
+
+
+class Checkpointer:
+    """Background checkpoint writer for one node.
+
+    ``checkpoint_now`` runs one full cycle synchronously: stamp (short
+    commit-lock barrier), stream + atomic publish, floor install,
+    retention, WAL reclaim.  ``start`` runs it on ``interval_s`` in a
+    daemon thread (``request`` nudges an immediate run).  Failures never
+    flip the store read-only and never truncate anything — they raise
+    :class:`CheckpointError` (or are logged by the loop) and the next
+    interval retries.
+    """
+
+    def __init__(self, store, txm, metrics=None, interval_s: float = 300.0,
+                 retain: int = 2):
+        assert store.log is not None, "checkpointing needs a durable log"
+        self.store = store
+        self.txm = txm
+        self.log = store.log
+        self.metrics = metrics
+        self.interval_s = float(interval_s)
+        self.retain = max(1, int(retain))
+        self.root = checkpoint_root(self.log.dir)
+        #: name -> callable returning a msgpack-able blob captured under
+        #: the commit lock (cluster membership, embedder state, ...)
+        self.extras_providers: Dict[str, Callable[[], Any]] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        #: True while a generation rotation from a FAILED attempt is
+        #: still unpublished: the retry reuses it instead of rotating
+        #: again, so a persistent-ENOSPC outage can't accumulate open
+        #: segment handles/files cycle after cycle
+        self._rotated_unpublished = False
+        #: running total of WAL bytes reclaimed (node-status block)
+        self.reclaimed_total = 0
+        #: summary of the last published checkpoint (seeded from disk so
+        #: a recovered node's status shows its inherited image)
+        self.last: Optional[dict] = None
+        self._next_id = 1
+        cks = list_checkpoints(self.root)
+        if cks:
+            self._next_id = cks[-1][0] + 1
+            self.last = load_manifest(cks[-1][1])
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "Checkpointer":
+        if self._thread is None and self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="antidote-checkpoint"
+            )
+            self._thread.start()
+        return self
+
+    def request(self) -> None:
+        """Nudge the loop to checkpoint as soon as possible (e.g. after
+        importing a shard from a compacted source)."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=30)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+            if self._stop:
+                return
+            try:
+                self.checkpoint_now()
+            except CheckpointError as e:
+                log.warning("periodic checkpoint failed (will retry on "
+                            "the next interval): %s", e)
+            except Exception:
+                log.exception("periodic checkpoint failed unexpectedly")
+
+    # -- observability --------------------------------------------------
+    def status(self) -> dict:
+        last = self.last
+        out = {
+            "interval_s": self.interval_s,
+            "retain": self.retain,
+            "reclaimed_bytes_total": self.reclaimed_total,
+            "tail_records": int(
+                (self.log.seqs - self.log.floor_seqs).sum()),
+        }
+        if last is not None:
+            out.update({
+                "last_id": last.get("id"),
+                "stamp_vc_max": last.get("stamp_vc_max"),
+                "image_bytes": last.get("image_bytes"),
+                "age_s": round(time.time() - last.get("created_at", 0), 1),
+            })
+        if self.metrics is not None and last is not None:
+            self.metrics.checkpoint_age.set(out["age_s"])
+        return out
+
+    # -- the cycle ------------------------------------------------------
+    def checkpoint_now(self) -> dict:
+        with self._lock:
+            t0 = time.monotonic()
+            with self.txm.checkpoint_barrier:
+                cap, frozen = self._capture_locked()
+            barrier_s = time.monotonic() - t0
+            try:
+                self._scan_chains(cap)
+                path, manifest = self._write_atomic(cap, frozen)
+            except CheckpointError:
+                raise
+            except BaseException as e:
+                # a failed checkpoint must leave the store EXACTLY as it
+                # was: no floor movement, no truncation, and — crucially
+                # for the ENOSPC case — no read-only flip (that mode is
+                # the WAL append path's contract, not ours; reads and
+                # writes keep flowing on the intact log).  Rotated-out
+                # segment handles are closed NOW (their files stay; the
+                # retry reuses the already-rotated generation), so hours
+                # of failing cycles never leak fds
+                self.log.drain_retired()
+                if self.metrics is not None:
+                    self.metrics.checkpoint_total.inc(status="error")
+                raise CheckpointError(
+                    f"checkpoint aborted, nothing published: {e}"
+                ) from e
+            with self.txm.checkpoint_barrier:
+                self.log.set_floor(cap["floor_seqs"], cap["chain_floor"])
+            self._rotated_unpublished = False
+            reclaimed = self._retire_and_reclaim(cap)
+            self.reclaimed_total += reclaimed
+            manifest["reclaimed_bytes"] = reclaimed
+            self.last = manifest
+            if self.metrics is not None:
+                self.metrics.checkpoint_total.inc(status="ok")
+                self.metrics.wal_reclaimed.inc(reclaimed)
+                self.metrics.checkpoint_age.set(0.0)
+            total_s = time.monotonic() - t0
+            log.info(
+                "checkpoint %d published: %d keys, %d table rows, "
+                "%.1f MiB image, %.1f MiB WAL reclaimed "
+                "(stamp barrier %.0f ms, total %.2f s)",
+                manifest["id"], manifest["n_keys"], manifest["n_rows"],
+                manifest["image_bytes"] / 2**20, reclaimed / 2**20,
+                barrier_s * 1e3, total_s,
+            )
+            return dict(manifest, barrier_ms=round(barrier_s * 1e3, 1),
+                        total_s=round(total_s, 3))
+
+    def _capture_locked(self) -> Tuple[dict, dict]:
+        """The consistent cut, under the commit lock: host bookkeeping
+        is copied, device heads are COPY-DISPATCHED (jit copies of the
+        immutable head buffers — materialized outside the lock; the
+        dispatch order protects them from later donating kernels), and
+        the WAL rotates onto a fresh segment generation so the floor
+        cleanly separates image from tail."""
+        store, txm, logm = self.store, self.txm, self.log
+        cap: Dict[str, Any] = {
+            "id": self._next_id,
+            "n_shards": store.cfg.n_shards,
+            "max_dcs": store.cfg.max_dcs,
+            "stamp_vc": store.applied_vc.copy(),
+            "commit_counter": int(txm.commit_counter),
+            "op_ids": logm.op_ids.copy(),
+            "prev_floor": logm.floor_seqs.copy(),
+            "prev_chain_floor": logm.chain_floor.copy(),
+            "committed_keys": dict(txm.committed_keys),
+            "directory": dict(store.directory),
+            "blobs": dict(store.blobs._by_handle),
+            "blob_seen": [sorted(s) for s in logm._blob_seen],
+            "shard_resets": dict(logm.shard_resets),
+            "extras": {},
+        }
+        for name, provider in self.extras_providers.items():
+            try:
+                cap["extras"][name] = provider()
+            except Exception:
+                log.exception("checkpoint extras provider %r failed "
+                              "(omitted from the image)", name)
+        frozen: Dict[str, dict] = {}
+        for tname, t in store.tables.items():
+            used = t.used_rows.copy()
+            if int(used.max()) == 0:
+                continue
+            frozen[tname] = {
+                "slot": t._copy_tree_fn((t.head, t.head_vc)),
+                "used": used,
+                "slots_ub": t.slots_ub.copy(),
+                "max_abs_delta": int(t.max_abs_delta),
+                "max_commit_vc": t.max_commit_vc.copy(),
+            }
+        # rotate onto a fresh segment generation — unless a FAILED
+        # attempt already did and never published: its generation is
+        # still "everything since the last publish", and rotating again
+        # would open n_shards × n_segments new files per failing cycle
+        if not self._rotated_unpublished:
+            logm.rotate_generation()
+            self._rotated_unpublished = True
+        cap["floor_seqs"] = logm.seqs.copy()
+        self._next_id += 1
+        return cap, frozen
+
+    def _scan_chains(self, cap: dict) -> None:
+        """Replication txn-group counts at the new floor = counts at the
+        previous floor + groups in the (prev, new] sequence window, by
+        (origin, commit VC) identity — one bounded scan of the data
+        written since the last checkpoint (the first checkpoint scans
+        the whole log, once, in the background)."""
+        from antidote_tpu.log import shard_segment_paths
+
+        logm = self.log
+        chains = cap["prev_chain_floor"].copy()
+        for shard in range(cap["n_shards"]):
+            lo = int(cap["prev_floor"][shard])
+            hi = int(cap["floor_seqs"][shard])
+            if hi <= lo:
+                continue
+            seen: set = set()
+            for rec in replay_segments(shard_segment_paths(
+                    logm.dir, shard, logm.n_segments)):
+                q = rec.get("q")
+                if q is None:
+                    if lo > 0:
+                        continue  # legacy prefix already below prev floor
+                elif q <= lo or q > hi:
+                    continue
+                ident = (int(rec["o"]),
+                         tuple(int(x) for x in rec["vc"]))
+                if ident in seen:
+                    continue
+                seen.add(ident)
+                chains[shard, int(rec["o"])] += 1
+        cap["chain_floor"] = chains
+
+    def _write_atomic(self, cap: dict, frozen: dict) -> Tuple[str, dict]:
+        from antidote_tpu.store.handoff import opaque, pack
+
+        tables: Dict[str, dict] = {}
+        for tname, fz in frozen.items():
+            used = fz["used"]
+            u_cap = int(used.max())
+            head_cp, head_vc_cp = fz["slot"]
+            tables[tname] = {
+                "used_rows": used,
+                "head": {f: np.asarray(x)[:, :u_cap].copy()
+                         for f, x in head_cp.items()},
+                "head_vc": np.asarray(head_vc_cp)[:, :u_cap].copy(),
+                "slots_ub": fz["slots_ub"][:, :u_cap].copy(),
+                "max_abs_delta": fz["max_abs_delta"],
+                "max_commit_vc": fz["max_commit_vc"],
+            }
+        image = {
+            "version": 1,
+            "id": cap["id"],
+            "n_shards": cap["n_shards"],
+            "max_dcs": cap["max_dcs"],
+            "stamp_vc": cap["stamp_vc"],
+            "commit_counter": cap["commit_counter"],
+            "floor_seqs": cap["floor_seqs"],
+            "chain_floor": cap["chain_floor"],
+            "op_ids": cap["op_ids"],
+            "shard_resets": {str(k): v
+                             for k, v in cap["shard_resets"].items()},
+            # opaque(): the two per-key lists are the image's big flat
+            # payloads — one C-speed msgpack pass each, not a recursive
+            # Python walk per entry (5M dec() calls at 1M keys)
+            "committed_keys": opaque([
+                [k, b, int(v)] for (k, b), v in cap["committed_keys"].items()
+            ]),
+            "directory": opaque([
+                [key, bucket, tname, int(shard), int(row)]
+                for (key, bucket), (tname, shard, row)
+                in cap["directory"].items()
+            ]),
+            "blobs": opaque([[int(h), bytes(d)]
+                             for h, d in cap["blobs"].items()]),
+            "blob_seen": opaque(cap["blob_seen"]),
+            "tables": tables,
+            "extras": cap["extras"],
+        }
+        data = pack(image)
+        crc = zlib.crc32(data) & 0xFFFFFFFF
+        os.makedirs(self.root, exist_ok=True)
+        tmp = os.path.join(self.root, f"tmp.{os.getpid()}.{cap['id']}")
+        final = os.path.join(self.root, f"ckpt_{cap['id']}")
+        manifest = {
+            "id": cap["id"],
+            "created_at": time.time(),
+            "image_bytes": len(data),
+            "image_crc32": crc,
+            "n_keys": len(cap["directory"]),
+            "n_rows": int(sum(int(t["used_rows"].sum())
+                              for t in tables.values())),
+            "tables": sorted(tables),
+            "commit_counter": cap["commit_counter"],
+            "stamp_vc_max": [int(x) for x in cap["stamp_vc"].max(axis=0)],
+            "floor_seqs": [int(x) for x in cap["floor_seqs"]],
+        }
+        try:
+            shutil.rmtree(tmp, ignore_errors=True)  # reclaim-ok: stale
+            # temp dir from a crashed writer — never a published image
+            os.makedirs(tmp)
+            img_path = os.path.join(tmp, _IMAGE)
+            with open(img_path, "wb") as f:
+                _faulted_write(f, data, f"ckpt_{cap['id']}")
+                f.flush()
+                # image durability rides the group-fsync coordinator —
+                # one fsync stream process-wide, coalesced with any
+                # commit barriers in flight
+                self.log._fsync.submit(
+                    [_ImageFsync(f.fileno(), f"ckpt_{cap['id']}")]
+                ).wait()
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())  # fsync-ok: manifest must be durable
+                # before the rename publishes the image
+            _fsync_dir(tmp)
+            d = faults.hit("ckpt.rename", key=f"ckpt_{cap['id']}")
+            if d is not None:
+                if d.action == "delay" and d.arg:
+                    time.sleep(float(d.arg))
+                elif d.action in ("error", "io_error", "enospc"):
+                    raise OSError(errno.EIO,
+                                  "injected fault: ckpt.rename")
+            os.rename(tmp, final)
+            _fsync_dir(self.root)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)  # reclaim-ok: failed
+            # attempt's temp dir; the published set is untouched
+            raise
+        return final, manifest
+
+    def _retire_and_reclaim(self, cap: dict) -> int:
+        """Post-publish housekeeping: drop images beyond the retention
+        window, then reclaim WAL files wholly below the OLDEST RETAINED
+        image's floor — not the newest.  The retention window is the
+        recovery safety margin (a corrupt newest image falls back to an
+        older one), and that fallback needs the older image's tail still
+        on disk.  Both steps are best-effort — a failure here never
+        unpublishes the image."""
+        reclaim_floors = np.asarray(cap["floor_seqs"], np.int64)
+        try:
+            published = list_checkpoints(self.root)
+            for _id, path in published[:-self.retain]:
+                shutil.rmtree(path, ignore_errors=True)  # reclaim-ok:
+                # beyond the retention window; newer images cover it
+            for name in os.listdir(self.root):
+                if name.startswith("tmp."):
+                    shutil.rmtree(os.path.join(self.root, name),
+                                  ignore_errors=True)  # reclaim-ok:
+                    # orphaned temp dir from a crashed/failed writer
+            floors = [
+                m["floor_seqs"] for _id, p in published[-self.retain:]
+                if (m := load_manifest(p)) is not None
+                and m.get("floor_seqs") is not None
+            ]
+            if floors:
+                reclaim_floors = np.minimum.reduce(
+                    [np.asarray(f, np.int64) for f in floors])
+        except OSError:
+            log.warning("checkpoint retention sweep failed", exc_info=True)
+        try:
+            return self.log.reclaim_below(reclaim_floors)
+        except Exception:
+            log.warning("WAL reclaim below the checkpoint floor failed "
+                        "(will retry next checkpoint)", exc_info=True)
+            return 0

@@ -247,6 +247,8 @@ class ShardWAL:
 
     def commit(self) -> None:
         covered = self.pending_bytes
+        if self._h is None and self._f is None:
+            return  # retired segment (generation rotation) — nothing to flush
         if self._h is not None:
             ctypes.set_errno(0)
             if self._lib.wal_commit(self._h) != 0:
@@ -265,6 +267,12 @@ class ShardWAL:
 
     def sync(self) -> None:
         covered = self.pending_bytes
+        if self._h is None and self._f is None:
+            # retired segment: a commit barrier that raced the generation
+            # rotation may still submit it to the fsync coordinator — its
+            # records are covered by the checkpoint image by then, so a
+            # no-op is the correct durability answer (never a crash)
+            return
         if faults.get_injector() is not None:
             self._faulted_fsync()
         if self._h is not None:
@@ -448,6 +456,20 @@ def replay_segments(paths: Sequence[str]) -> Iterator[dict]:
     for _k, rec in heapq.merge(*[keyed(p) for p in paths],
                                key=lambda item: item[0]):
         yield rec
+
+
+def wholly_below(path: str, floor: int) -> bool:
+    """True iff every decodable record in ``path`` is covered by a
+    checkpoint floor: its append sequence ``"q"`` is ≤ ``floor``, or it
+    is a legacy (pre-segmentation) record with no ``"q"`` at all — those
+    can only predate any checkpoint, since checkpointing builds stamp a
+    sequence on every record.  The reclaim guard: a WAL file may be
+    deleted only when this holds (never a raw unlink)."""
+    for rec in replay(path):
+        q = rec.get("q")
+        if q is not None and int(q) > floor:
+            return False
+    return True
 
 
 def replay(path: str) -> Iterator[dict]:

@@ -476,6 +476,36 @@ class NodeMetrics:
             "commutative bypass (no reads, commutative-type blind "
             "updates only, no explicit certify=true)",
         )
+        # checkpointed fast restart (ISSUE 8): recovery phase timings,
+        # replayed-record counts, image age, and WAL bytes reclaimed by
+        # the guarded truncation below the checkpoint floor
+        self.recovery_seconds = r.gauge(
+            "antidote_recovery_seconds",
+            "Wall time of the last recovery, by phase (checkpoint = "
+            "image load + install; tail = WAL tail replay)",
+            ("phase",),
+        )
+        self.recovery_records = r.counter(
+            "antidote_recovery_records_total",
+            "WAL records replayed by recovery (tail-only when a "
+            "checkpoint image was installed)",
+        )
+        self.checkpoint_age = r.gauge(
+            "antidote_checkpoint_age_seconds",
+            "Age of the newest published checkpoint image (how much "
+            "tail a crash-now restart would replay)",
+        )
+        self.wal_reclaimed = r.counter(
+            "antidote_wal_bytes_reclaimed_total",
+            "WAL bytes reclaimed by checkpoint truncation (files wholly "
+            "below a published floor)",
+        )
+        self.checkpoint_total = r.counter(
+            "antidote_checkpoint_total",
+            "Checkpoint attempts by outcome (ok | error); an error "
+            "publishes and truncates nothing",
+            ("status",),
+        )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)
         net_metrics().attach(r)

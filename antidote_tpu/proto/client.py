@@ -163,6 +163,12 @@ class AntidoteClient:
         return self._call(MessageCode.NODE_STATUS,
                           {"include_ready": include_ready})["status"]
 
+    def checkpoint_now(self) -> dict:
+        """Run one synchronous checkpoint cycle on the server (console
+        `checkpoint-now`); returns the published manifest summary.
+        Blocks for the image stream — admin use, not a data-path call."""
+        return self._call(MessageCode.CHECKPOINT_NOW, {})["checkpoint"]
+
     def close(self) -> None:
         try:
             self._rfile.close()

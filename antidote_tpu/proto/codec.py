@@ -31,6 +31,7 @@ class MessageCode(enum.IntEnum):
     CONNECT_TO_DCS = 9
     CREATE_DC = 10
     NODE_STATUS = 11  # console/ops extension (no reference pb equivalent)
+    CHECKPOINT_NOW = 12  # ops extension: synchronous checkpoint cycle
     # responses
     OPERATION_RESP = 64
     START_TRANSACTION_RESP = 65

@@ -1130,6 +1130,14 @@ class ProtocolServer:
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
             }
+        if code == MessageCode.CHECKPOINT_NOW:
+            # admin op, OUTSIDE the dispatch lock: the checkpointer has
+            # its own serialization, and streaming a multi-second image
+            # while holding the dispatch lock would park the locked
+            # plane behind an operator command
+            return MessageCode.OPERATION_RESP, {
+                "checkpoint": self.node.checkpoint_now()
+            }
         with self._lock:
             # deadline re-checked at dequeue (= after the lock convoy):
             # a request that outlived its caller is not executed

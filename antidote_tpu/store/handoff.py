@@ -62,20 +62,40 @@ def export_shard(store: KVStore, shard: int,
     into wire bytes for a cross-node move.
     """
     with_log = include_log and store.log is not None
+    # a checkpoint-truncated source (ISSUE 8): the ride-along log is only
+    # the tail above the compaction floor — the importer's WAL cannot
+    # rebuild the rows' earlier history until a LOCAL checkpoint covers
+    # them (the import path nudges/warns about exactly that)
+    compacted = bool(
+        with_log and int(store.log.floor_seqs[int(shard)]) > 0
+    )
     pkg: Dict[str, Any] = {
         "shard": int(shard),
         "applied_vc": store.applied_vc[shard].copy(),
         "tables": {},
         "directory": [],
         "log": [],
-        # payload bytes for value handles: when the WAL rides along, its
-        # records carry every handle this shard references (first use per
-        # shard logs the bytes — log/__init__.py _blob_seen), so shipping
-        # the blob dict again would be pure duplication.  Without a log we
-        # cannot tell which handles the shard's state references (handle
-        # lanes are type-specific), so ship the whole content-addressed
-        # dict — receivers setdefault, duplicates are free.
-        "blobs": [] if with_log else [
+        "compacted": compacted,
+        # per-origin replication-group counts below the source's
+        # compaction floor: the ride-along log is only the tail, so the
+        # importer must seed its chain numbering from here or every
+        # WAL-derived position (restore_from_log, extras-less
+        # adopt_shard, catch-up serving) would restart at the tail
+        # count and remote subscribers would drop the shard's next
+        # commits as duplicates
+        "chain_floor": (store.log.chain_floor[int(shard)].tolist()
+                        if compacted else None),
+        # payload bytes for value handles: when the FULL WAL rides along,
+        # its records carry every handle this shard references (first use
+        # per shard logs the bytes — log/__init__.py _blob_seen), so
+        # shipping the blob dict again would be pure duplication.
+        # Without a log — or with only a compacted tail, whose records
+        # may reference pre-floor handles whose bytes live only in the
+        # checkpoint image — we cannot tell which handles the shard's
+        # state references (handle lanes are type-specific), so ship the
+        # whole content-addressed dict; receivers setdefault, duplicates
+        # are free.
+        "blobs": [] if (with_log and not compacted) else [
             (int(h), bytes(d)) for h, d in store.blobs._by_handle.items()
         ],
     }
@@ -124,7 +144,8 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
                 f"{int(t.used_rows[dst])} {tname!r} rows; hand off into an "
                 "empty shard (exclusive ownership per ring epoch)"
             )
-    if store.log is not None and pkg["tables"] and not pkg["log"]:
+    if (store.log is not None and pkg["tables"] and not pkg["log"]
+            and not pkg.get("compacted")):
         raise ValueError(
             "import_shard: this replica is durable (WAL attached) but the "
             "package carries no log records — the imported rows could "
@@ -187,6 +208,10 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
         store.blobs.intern_bytes(int(h), bytes(data))
     np.maximum(store.applied_vc[dst], pkg["applied_vc"],
                out=store.applied_vc[dst])
+    if pkg.get("chain_floor") and store.log is not None:
+        # compacted source: continue the replication chains where the
+        # source's checkpoint image left them (see export_shard)
+        store.log.set_chain_floor(dst, pkg["chain_floor"])
     for rec in pkg["log"]:
         # the ride-along WAL records carry this shard's blob bytes
         eff = effect_from_rec(rec)
@@ -230,6 +255,15 @@ def drop_shard(store: KVStore, shard: int) -> None:
         store.log.truncate_shard(shard)
 
 
+def opaque(obj: Any) -> Dict[str, Any]:
+    """Pre-pack a large plain-data value (no ndarrays inside) so
+    :func:`pack`/:func:`unpack`'s recursive walk crosses it as ONE node:
+    a million-entry directory list costs one C-speed msgpack pass
+    instead of five million Python ``dec`` calls (the measured majority
+    of checkpoint image decode time at 1M keys — ISSUE 8)."""
+    return {"__mp": msgpack.packb(obj, use_bin_type=True)}
+
+
 def pack(pkg: Dict[str, Any]) -> bytes:
     """Wire form of an exported shard (msgpack; arrays as raw bytes)."""
 
@@ -251,6 +285,9 @@ def unpack(data: bytes) -> Dict[str, Any]:
         if isinstance(x, dict):
             if x.get("__nd"):
                 return np.frombuffer(x["b"], x["d"]).reshape(x["s"]).copy()
+            if x.get("__mp") is not None:
+                return msgpack.unpackb(x["__mp"], raw=False,
+                                       strict_map_key=False)
             return {k: dec(v) for k, v in x.items()}
         if isinstance(x, list):
             return [dec(v) for v in x]

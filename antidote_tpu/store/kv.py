@@ -1277,6 +1277,23 @@ class KVStore:
                 f"incomplete read for {[w[1] for w in wants]!r} and no log "
                 "attached: read VC below retained snapshot coverage"
             )
+        if (int(self.log.floor_seqs[shard]) > 0
+                or self.log.chain_floor[shard].any()):
+            # the shard's WAL was compacted below a checkpoint floor
+            # (chain_floor alone marks a shard IMPORTED from a compacted
+            # source — its ride-along log was tail-only): the
+            # prefix this rebuild would need is covered only by the image
+            # (which holds heads, not per-op history), so replaying the
+            # tail alone would silently produce a state missing the
+            # pre-checkpoint ops.  Surface the horizon instead — the
+            # reference's prune_ops draws the same line at the min cached
+            # snapshot (SURVEY §2.3), lifted here to the store level.
+            raise RuntimeError(
+                f"read below the compaction horizon for "
+                f"{[w[1] for w in wants]!r}: shard {shard}'s log is "
+                "checkpoint-truncated and no longer holds history below "
+                "the checkpoint stamp"
+            )
         import jax
         import jax.numpy as jnp
 
@@ -1325,11 +1342,15 @@ class KVStore:
         """
         assert self.log is not None
         last_commit: Dict = {}
+        #: records replayed by the last recover() call (the recovery
+        #: observability satellite; tail-only under a checkpoint floor)
+        self.last_recovery_records = 0
         for shard in range(self.cfg.n_shards):
             batch: List[Effect] = []
             vcs: List[np.ndarray] = []
             orgs: List[int] = []
             for rec in self.log.replay_shard(shard):
+                self.last_recovery_records += 1
                 eff = effect_from_rec(rec)
                 for h, data in eff.blob_refs:
                     self.blobs.intern_bytes(h, data)

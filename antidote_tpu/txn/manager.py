@@ -230,6 +230,28 @@ class TransactionManager:
     def _publish_serving_epoch_locked(self) -> str:
         return self.store.publish_serving_epoch(self.serving_epoch_vc())
 
+    @property
+    def checkpoint_barrier(self):
+        """The lock a checkpoint stamp must hold (ISSUE 8): under it, no
+        commit, remote-ingress apply, WAL append or membership move is in
+        flight, so (applied VC, commit counter, certification stamps,
+        directory, WAL append sequences) form one consistent cut — the
+        image's clock stamp and per-shard floors.  The barrier is SHORT
+        by design (host copies + device copy dispatches; the image
+        streams to disk outside it).
+
+        RO-mode interplay: the degraded read-only mode is the WAL APPEND
+        path's contract (``_enter_read_only`` fires only on a refused
+        commit append/fsync).  A checkpoint hitting ENOSPC while
+        streaming its image fails that checkpoint alone —
+        :class:`~antidote_tpu.log.checkpoint.CheckpointError`, nothing
+        published, nothing truncated — and must never flip this mode:
+        the log is intact, so writes remain exactly as durable as they
+        were.  Conversely a store already read-only can still checkpoint
+        (and a checkpoint-based restart of it must come back serving
+        reads)."""
+        return self.commit_lock
+
     # ------------------------------------------------------------------
     # transaction lifecycle (antidote.erl API shapes)
     # ------------------------------------------------------------------

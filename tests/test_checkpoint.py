@@ -1,0 +1,453 @@
+"""Checkpointed fast restart (ISSUE 8): VC-stamped images, WAL tail
+truncation, crash-safe compaction.
+
+The invariant everything here pins: recovery from (checkpoint image +
+WAL tail) is OBSERVABLY IDENTICAL to a full-log replay — same values at
+every readable clock, same op-id chains, same append sequences, same
+stable snapshot — and a failed/interrupted checkpoint changes nothing
+at all (no floor movement, no truncation, no read-only flip).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from antidote_tpu import faults
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.log import checkpoint as ckpt
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture
+def dcfg():
+    # small tables + several WAL segments so checkpoints exercise the
+    # generation rotation and tier promotion paths cheaply
+    return AntidoteConfig(
+        n_shards=4, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=8, mv_slots=4, rga_slots=16, keys_per_table=64,
+        batch_buckets=(16, 64), wal_segments=3,
+    )
+
+
+def wal_bytes(log_dir) -> int:
+    return sum(
+        os.path.getsize(os.path.join(log_dir, f))
+        for f in os.listdir(log_dir) if f.endswith(".wal")
+    )
+
+
+def digest(node) -> dict:
+    """The byte-identical-recovery digest (the chaos suite's shape)."""
+    return {
+        "op_ids": node.store.log.op_ids.tolist(),
+        "seqs": node.store.log.seqs.tolist(),
+        "stable": [int(x) for x in node.stable_vc()],
+        "commit_counter": int(node.txm.commit_counter),
+        "keys": len(node.store.directory),
+    }
+
+
+def populate(node, rounds=3):
+    for i in range(rounds):
+        node.update_objects([
+            ("c", "counter_pn", "b", ("increment", 7 + i)),
+            (f"c{i}", "counter_pn", "b", ("increment", i + 1)),
+            ("s", "set_aw", "b", ("add_all", [f"x{i}", f"y{i}"])),
+            ("r", "register_lww", "b", ("assign", f"val{i}")),
+        ])
+    node.update_objects([("s", "set_aw", "b", ("remove", "x0"))])
+
+
+def test_checkpoint_then_tail_recovery_byte_identical(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node)
+    summary = node.checkpoint_now()
+    assert summary["n_keys"] == len(node.store.directory)
+    assert summary["reclaimed_bytes"] > 0, "no WAL file fell below the floor"
+    # tail: writes after the stamp, including a map (composite keys)
+    vc = node.update_objects([
+        ("c", "counter_pn", "b", ("increment", 100)),
+        ("s", "set_aw", "b", ("add", "z")),
+        ("m", "map_rr", "b", ("update", {
+            ("f", "counter_pn"): ("increment", 3)})),
+    ])
+    objs = [("c", "counter_pn", "b"), ("s", "set_aw", "b"),
+            ("r", "register_lww", "b"), ("m", "map_rr", "b")]
+    want_vals, _ = node.read_objects(objs, clock=vc)
+    want = digest(node)
+    node.store.log.close()
+
+    for _ in range(2):  # two independent recoveries must agree
+        n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+        vals, _ = n2.read_objects(objs, clock=vc)
+        assert vals == want_vals
+        assert digest(n2) == want
+        assert (n2.store.log.floor_seqs > 0).any(), "fast path not engaged"
+        n2.store.log.close()
+    # chains continue: a post-recovery commit minted fresh dots and is
+    # itself recovered by the NEXT restart
+    n3 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    vc2 = n3.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    assert vc2[n3.dc_id] > vc[n3.dc_id]
+    n3.store.log.close()
+    n4 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    vals, _ = n4.read_objects([("c", "counter_pn", "b")], clock=vc2)
+    assert vals == [want_vals[0] + 1]
+    n4.store.log.close()
+
+
+def test_fast_path_replays_only_the_tail(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node, rounds=5)
+    node.checkpoint_now()
+    node.update_objects([("c", "counter_pn", "b", ("increment", 1)),
+                         ("s", "set_aw", "b", ("add", "tail"))])
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    # exactly the two tail records were replayed (the recovery counter
+    # satellite): the pre-stamp history came from the image
+    assert n2.store.last_recovery_records == 2
+    assert n2.metrics.recovery_records.value() == 2
+    assert n2.metrics.recovery_seconds.value(phase="tail") > 0
+    assert n2.metrics.recovery_seconds.value(phase="checkpoint") > 0
+    blk = n2.status()["checkpoint"]
+    assert blk["last_id"] == 1 and blk["image_bytes"] > 0
+    n2.store.log.close()
+
+
+def test_wal_bounded_under_sustained_writes(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    sizes = []
+    for round_ in range(6):
+        for i in range(40):
+            node.update_objects([
+                (i % 8, "counter_pn", "b", ("increment", 1))])
+        node.checkpoint_now()
+        sizes.append(wal_bytes(log_dir))
+    # reclaim keeps the retention window's tail (retain=2 → the last
+    # two inter-checkpoint windows) but never the whole history: the
+    # steady state is flat while total writes grow linearly
+    assert sizes[-1] <= sizes[1] * 2, sizes
+    assert node.metrics.wal_reclaimed.value() > 0
+    assert node.checkpointer.reclaimed_total > 0
+    # retention: at most 2 images (default) remain published
+    assert len(ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))) == 2
+    vals, _ = node.read_objects([(i, "counter_pn", "b") for i in range(8)])
+    assert vals == [30] * 8
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    vals, _ = n2.read_objects([(i, "counter_pn", "b") for i in range(8)])
+    assert vals == [30] * 8
+    n2.store.log.close()
+
+
+def test_checkpoint_enospc_never_flips_read_only_or_truncates(dcfg,
+                                                              tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node)
+    before_files = {
+        f: os.path.getsize(os.path.join(log_dir, f))
+        for f in os.listdir(log_dir) if f.endswith(".wal")
+    }
+    faults.install(faults.FaultPlan(seed=1).enospc("ckpt.write"))
+    with pytest.raises(ckpt.CheckpointError):
+        node.checkpoint_now()
+    # satellite contract: a checkpoint ENOSPC is NOT a WAL ENOSPC — the
+    # store stays writable, nothing was truncated, nothing published
+    assert node.txm.read_only_reason is None
+    assert node.metrics.degraded_read_only.value() == 0
+    assert (node.store.log.floor_seqs == 0).all()
+    after_files = {
+        f: os.path.getsize(os.path.join(log_dir, f))
+        for f in os.listdir(log_dir)
+        if f.endswith(".wal") and f in before_files
+    }
+    assert after_files == before_files, "a failed checkpoint touched the WAL"
+    assert ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir)) == []
+    assert node.metrics.checkpoint_total.value(status="error") == 1
+    node.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    # the volume "heals": the next cycle publishes normally
+    faults.uninstall()
+    assert node.checkpoint_now()["id"] == 2
+    node.store.log.close()
+
+
+def test_checkpoint_fsync_and_rename_faults_abort_cleanly(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node, rounds=1)
+    for site in ("ckpt.fsync", "ckpt.rename"):
+        faults.install(faults.FaultPlan(seed=2).io_error(site, times=1))
+        with pytest.raises(ckpt.CheckpointError):
+            node.checkpoint_now()
+        faults.uninstall()
+        assert ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir)) == []
+        assert node.txm.read_only_reason is None
+    summary = node.checkpoint_now()
+    # crashed attempts' temp dirs are swept by the successful publish
+    leftovers = [f for f in os.listdir(ckpt.checkpoint_root(log_dir))
+                 if f.startswith("tmp.")]
+    assert leftovers == []
+    assert summary["id"] >= 3
+    node.store.log.close()
+
+
+def test_corrupt_newest_image_falls_back_to_older(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    node.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    node.checkpoint_now()
+    node.update_objects([("c", "counter_pn", "b", ("increment", 2))])
+    node.checkpoint_now()
+    vc = node.update_objects([("c", "counter_pn", "b", ("increment", 4))])
+    node.store.log.close()
+    # bit-rot the newest image: recovery must fall back to image 1 and
+    # replay a LONGER tail to the same state.  The floor-filtered replay
+    # makes this safe: image 1's floor keeps every record above it.
+    cks = ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))
+    assert len(cks) == 2
+    newest = os.path.join(cks[-1][1], "image.bin")
+    with open(newest, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    vals, _ = n2.read_objects([("c", "counter_pn", "b")], clock=vc)
+    assert vals == [7]
+    n2.store.log.close()
+
+
+def test_ro_degraded_store_serves_reads_after_checkpoint_restart(dcfg,
+                                                                 tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node)
+    node.checkpoint_now()
+    node.store.log.close()
+    # restart from the checkpoint onto a "full disk": writes shed typed,
+    # reads serve the checkpointed state (the RO satellite's second half)
+    from antidote_tpu.overload import ReadOnlyError
+
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    faults.install(faults.FaultPlan(seed=3).enospc("wal.append"))
+    with pytest.raises((ReadOnlyError, OSError)):
+        n2.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    assert n2.txm.read_only_reason is not None
+    vals, _ = n2.read_objects([("c", "counter_pn", "b"),
+                               ("r", "register_lww", "b")])
+    assert vals[0] >= 7 and vals[1] == "val2"
+    # a checkpoint is still possible while degraded (reads-only state)
+    faults.uninstall()
+    n2.txm._ro_probe_at = 0.0
+    n2.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    assert n2.txm.read_only_reason is None
+    n2.store.log.close()
+
+
+def test_read_below_compaction_horizon_raises_typed(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    vcs = [node.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+           for _ in range(25)]  # beyond ring+versions device coverage
+    node.checkpoint_now()
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    # at/above the stamp: served exactly
+    vals, _ = n2.read_objects([("k", "counter_pn", "b")])
+    assert vals == [25]
+    # far below the stamp: the pre-checkpoint per-op history is gone —
+    # a typed horizon error, never a silently wrong value
+    txn = n2.start_transaction()
+    txn.snapshot_vc = np.asarray(vcs[2], np.int32)
+    with pytest.raises(RuntimeError, match="compaction horizon"):
+        n2.read_objects([("k", "counter_pn", "b")], txn)
+    n2.abort_transaction(txn)
+    n2.store.log.close()
+
+
+def test_promoted_keys_roundtrip_through_checkpoint(dcfg, tmp_path):
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    # overflow the base tier before the stamp, and again after
+    node.update_objects([("big", "set_aw", "b",
+                          ("add_all", [f"e{i}" for i in range(20)]))])
+    assert node.store.promotions > 0
+    node.checkpoint_now()
+    node.update_objects([("big", "set_aw", "b",
+                          ("add_all", [f"t{i}" for i in range(40)]))])
+    want, _ = node.read_objects([("big", "set_aw", "b")])
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    vals, _ = n2.read_objects([("big", "set_aw", "b")])
+    assert sorted(vals[0]) == sorted(want[0])
+    n2.store.log.close()
+
+
+def test_relinquished_shard_does_not_resurrect_from_image(dcfg, tmp_path):
+    from antidote_tpu.store import handoff
+
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    keys = list(range(16))
+    node.update_objects([(k, "counter_pn", "b", ("increment", k + 1))
+                         for k in keys])
+    node.checkpoint_now()
+    # a shard moves away AFTER the stamp (two-phase move's relinquish
+    # leg): its WAL truncation bumps the durable shard-reset epoch
+    victim = node.store.directory[(0, "b")][1]
+    moved = {k for k in keys
+             if node.store.directory[(k, "b")][1] == victim}
+    handoff.drop_shard(node.store, victim)
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    for k in keys:
+        if k in moved:
+            # the image predates the move: the shard must NOT resurrect
+            assert (k, "b") not in n2.store.directory
+        else:
+            vals, _ = n2.read_objects([(k, "counter_pn", "b")])
+            assert vals == [k + 1]
+    n2.store.log.close()
+
+
+def test_interdc_chain_positions_survive_checkpointed_restart(dcfg,
+                                                              tmp_path):
+    """Egress opids and ingress positions resume from the image's chain
+    floors: after a checkpointed restart the geo peer sees neither
+    duplicates nor gaps — totals stay exact."""
+    from antidote_tpu.interdc import DCReplica
+    from antidote_tpu.interdc.transport import LoopbackHub
+
+    hub = LoopbackHub()
+    n0 = AntidoteNode(dcfg, dc_id=0, log_dir=str(tmp_path / "dc0"))
+    n1 = AntidoteNode(dcfg, dc_id=1, log_dir=str(tmp_path / "dc1"))
+    r0 = DCReplica(n0, hub, "dc0")
+    r1 = DCReplica(n1, hub, "dc1")
+    r0.observe_dc(r1), r1.observe_dc(r0)
+    total = 0
+    for i in range(5):
+        n0.update_objects([("g", "counter_pn", "b", ("increment", i + 1))])
+        total += i + 1
+    n1.update_objects([("g", "counter_pn", "b", ("increment", 100))])
+    total += 100
+    hub.pump()
+    n0.checkpoint_now()
+    n0.update_objects([("g", "counter_pn", "b", ("increment", 50))])
+    total += 50
+    hub.pump()
+    pre_pub = r0.pub_opid.copy()
+    pre_seen = dict(r0.last_seen)
+    # kill -9 DC0: only the WAL dir + checkpoint survive
+    hub.unregister(0)
+    n0.store.log.close()
+    del n0, r0
+    n0 = AntidoteNode(dcfg, dc_id=0, log_dir=str(tmp_path / "dc0"),
+                      recover=True)
+    r0 = DCReplica(n0, hub, "dc0")
+    r0.restore_from_log()
+    # chain positions byte-identical to the pre-kill live state (the
+    # image's chain floor + tail recount), not restarted at zero
+    assert (r0.pub_opid == pre_pub).all(), (r0.pub_opid, pre_pub)
+    assert r0.last_seen == pre_seen
+    r0.observe_dc(r1), r1.observe_dc(r0)
+    n0.update_objects([("g", "counter_pn", "b", ("increment", 7))])
+    total += 7
+    r0.heartbeat(), r1.heartbeat()
+    hub.pump()
+    target = np.maximum(n0.store.dc_max_vc(), n1.store.dc_max_vc())
+    for n in (n0, n1):
+        vals, _ = n.read_objects([("g", "counter_pn", "b")], clock=target)
+        assert vals == [total], (vals, total)
+    n0.store.log.close(), n1.store.log.close()
+
+
+def test_compacted_handoff_carries_chain_floor(dcfg, tmp_path):
+    """A shard exported from a checkpoint-compacted source ships its
+    replication chain floor: the importer's WAL-derived opid numbering
+    (restore_from_log, extras-less adopt, catch-up serving) continues
+    the true chain instead of restarting at the tail count — remote
+    subscribers would otherwise drop the new owner's commits as
+    duplicates."""
+    from antidote_tpu.interdc import DCReplica
+    from antidote_tpu.interdc.transport import LoopbackHub
+    from antidote_tpu.store import handoff
+
+    src = AntidoteNode(dcfg, log_dir=str(tmp_path / "src"))
+    for i in range(6):
+        src.update_objects([("hk", "counter_pn", "b", ("increment", 1))])
+    src.checkpoint_now()
+    src.update_objects([("hk", "counter_pn", "b", ("increment", 1))])
+    shard = src.store.directory[("hk", "b")][1]
+    # the source's true egress position for the shard's chain
+    src_rep = DCReplica(src, LoopbackHub(), "src")
+    src_rep.restore_from_log()
+    true_opid = int(src_rep.pub_opid[shard])
+    assert true_opid == 7
+    pkg = handoff.export_shard(src.store, shard)
+    assert pkg["compacted"] is True
+    assert pkg["chain_floor"] is not None and sum(pkg["chain_floor"]) > 0
+    dst = AntidoteNode(dcfg, log_dir=str(tmp_path / "dst"))
+    dst.receive_handoff(pkg)
+    assert dst.store.log.chain_base(shard, 0) == \
+        src.store.log.chain_base(shard, 0)
+    dst_rep = DCReplica(dst, LoopbackHub(), "dst")
+    dst_rep.restore_from_log()
+    assert int(dst_rep.pub_opid[shard]) == true_opid, (
+        dst_rep.pub_opid[shard], true_opid)
+    clock = [7] + [0] * (dcfg.max_dcs - 1)
+    vals, _ = dst.read_objects([("hk", "counter_pn", "b")], clock=clock)
+    assert vals == [7]
+    src.store.log.close(), dst.store.log.close()
+
+
+def test_checkpoint_now_over_the_wire(dcfg, tmp_path):
+    """The console's `checkpoint-now` path: CHECKPOINT_NOW over the
+    native dialect runs one synchronous cycle and returns the manifest;
+    node status exposes the checkpoint block with the published stamp."""
+    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.server import ProtocolServer
+
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "wal"))
+    srv = ProtocolServer(node, port=0)
+    try:
+        c = AntidoteClient(port=srv.port)
+        c.update_objects([("w", "counter_pn", "b", ("increment", 3))])
+        summary = c.checkpoint_now()
+        assert summary["id"] == 1 and summary["n_keys"] >= 1
+        st = c.node_status()
+        assert st["checkpoint"]["last_id"] == 1
+        assert st["checkpoint"]["reclaimed_bytes_total"] > 0
+        c.close()
+    finally:
+        srv.close()
+        node.store.log.close()
+
+
+def test_inspect_checkpoint_console(dcfg, tmp_path, capsys):
+    from antidote_tpu import console
+
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    populate(node, rounds=1)
+    node.checkpoint_now()
+    node.store.log.close()
+    rc = console.main(["inspect-checkpoint", "--log-dir", log_dir])
+    assert rc == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert out["latest"]["verified"] is True
+    assert out["latest"]["keys"] == len(node.store.directory)
+    assert out["published"][-1]["id"] == out["latest"]["id"]

@@ -19,7 +19,11 @@ Checks (each one has caught a real bug class in this codebase's history):
     maxsize, ``collections.deque()`` without a maxlen, and
     queue-factory ``defaultdict``s must either carry an explicit bound
     or a ``# bounded-by: <reason>`` annotation within the three lines
-    above — saturation must shed, never buffer without limit (PR 4).
+    above — saturation must shed, never buffer without limit (PR 4);
+  * file deletion (``os.remove``/``os.unlink``/``rmtree``) outside
+    ``antidote_tpu/log/`` without a ``# reclaim-ok:`` note — WAL and
+    checkpoint files are reclaimed only through the guarded floor APIs
+    (ISSUE 8).
 
 Usage: python tools/lint.py [paths...]   (default: antidote_tpu tests
 bench.py bench_suite.py bench_wire.py tpu_smoke.py __graft_entry__.py)
@@ -114,6 +118,7 @@ def check_file(path: str):
     _check_unbounded_queues(tree, path, lines, problems)
     _check_serving_syncs(path, lines, problems)
     _check_fsync_policy(path, lines, problems)
+    _check_reclaim_policy(path, lines, problems)
     return problems
 
 
@@ -240,6 +245,47 @@ def _check_fsync_policy(path, lines, problems) -> None:
                 "route durability through the WAL's group-fsync "
                 "coordinator, or justify with '# fsync-ok: <reason>'"
             )
+
+
+#: the one package allowed to delete durable files freely: log/ owns the
+#: WAL + checkpoint lifecycle and its deletions run behind guarded APIs
+#: (reclaim_below scans every record against the published floor before
+#: an unlink; truncate_shard is the handoff drop).  A file deletion
+#: anywhere else is either a durability bug waiting to happen (WAL or
+#: checkpoint data silently removed outside the floor discipline —
+#: ISSUE 8) or a deliberate temp/sidecar cleanup that must say so with a
+#: ``# reclaim-ok: <reason>`` note.
+_RECLAIM_OWNER = os.path.join("antidote_tpu", "log") + os.sep
+_RECLAIM_TOKENS = ("os.remove(", "os.unlink(", "rmtree(")
+
+
+def _check_reclaim_policy(path, lines, problems) -> None:
+    """Reject file deletion (``os.remove``/``os.unlink``/``rmtree``)
+    outside ``antidote_tpu/log/`` without a ``# reclaim-ok: <reason>``
+    annotation on the line or within the three preceding lines — WAL and
+    checkpoint files are only ever reclaimed through the guarded floor
+    APIs."""
+    norm = os.path.normpath(path)
+    if _RECLAIM_OWNER in norm or os.sep + "tests" + os.sep in norm \
+            or norm.startswith("tests" + os.sep) \
+            or os.path.basename(norm) == "lint.py":  # the rule's source
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("reclaim-ok:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        for tok in _RECLAIM_TOKENS:
+            if tok in code and not annotated(i) and "reclaim-ok:" not in ln:
+                problems.append(
+                    f"{path}:{i}: file deletion '{tok}' outside "
+                    "antidote_tpu/log/ — WAL/checkpoint reclaim must go "
+                    "through the guarded floor APIs (LogManager."
+                    "reclaim_below / truncate_shard), or justify with "
+                    "'# reclaim-ok: <reason>'"
+                )
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
