@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -49,6 +49,16 @@ perf-smoke:
 restart-smoke:
 	$(PY) tools/bench_restart.py --smoke --assert-bounds
 	$(PY) -m pytest tests/test_checkpoint.py -q
+
+# follower read tier (ISSUE 9): the deterministic follower suite plus a
+# short live fanout run — owner + followers boot for real, SessionClients
+# assert read-your-writes on every write→read pair, and the gate is
+# STRUCTURAL only (zero session violations, nonzero throughput); the
+# frozen follower_fanout scaling curve in BENCH_WIRE_cluster_cpu.json is
+# never a ratchet
+replica-smoke:
+	$(PY) -m pytest tests/test_follower.py -q
+	$(PY) bench_wire.py --follower-fanout --smoke --assert-bounds
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

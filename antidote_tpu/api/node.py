@@ -368,19 +368,30 @@ class AntidoteNode:
 
         _handoff.import_shard(self.store, pkg, shard)
         if pkg.get("compacted"):
-            # the source's WAL was checkpoint-truncated, so the package's
-            # ride-along log holds only the tail: this node's WAL cannot
-            # rebuild the imported rows' pre-checkpoint history until a
-            # LOCAL checkpoint covers them.  Nudge the checkpointer (or
-            # tell the operator loudly) — see docs/operations.md.
-            if self.checkpointer is not None:
-                self.checkpointer.request()
+            # SYNCHRONOUS import-then-checkpoint barrier (ISSUE 9
+            # satellite, closing the PR-7 residual): the source's WAL was
+            # checkpoint-truncated, so the package's ride-along log holds
+            # only the tail — this node's WAL cannot rebuild the imported
+            # rows' pre-checkpoint history, and the in-memory chain floor
+            # installed above is not durable either.  The old
+            # nudge-the-checkpointer left a window where a crash lost the
+            # moved rows' pre-checkpoint state silently; now the import
+            # does not RETURN (and therefore the two-phase move's confirm
+            # and the source's relinquish cannot proceed) until a local
+            # image covers the moved rows.  A failed checkpoint fails the
+            # import loudly — the source keeps the shard.
+            if self.store.log is not None:
+                summary = self.checkpoint_now()
+                logging.getLogger("antidote_tpu").info(
+                    "compacted-source shard import sealed by local "
+                    "checkpoint %s (import-then-checkpoint barrier)",
+                    summary.get("id"),
+                )
             else:
                 logging.getLogger("antidote_tpu").warning(
                     "imported a shard from a checkpoint-compacted source "
-                    "with no checkpointer attached: run a checkpoint on "
-                    "this node before relying on its crash recovery for "
-                    "the imported rows"
+                    "into a LOG-LESS node: there is no durable history "
+                    "for the moved rows at all (ephemeral mode)"
                 )
         self.txm.commit_counter = max(
             self.txm.commit_counter,

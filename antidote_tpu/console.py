@@ -72,6 +72,40 @@ def cmd_serve(args) -> int:
     # cannot install a plan in-process, so one may ride in the env
     _faults.install_from_env()
 
+    owner_addr = None
+    if args.follower_of:
+        # follower replica (ISSUE 9): adopt the OWNER's deployment shape
+        # and dc lane — a follower is a replica of that exact store
+        if args.log_dir is None:
+            log("--follower-of requires --log-dir (followers install "
+                "checkpoint images into a durable WAL)")
+            return 2
+        oh, op_ = args.follower_of.rsplit(":", 1)
+        owner_addr = (oh, int(op_))
+        from antidote_tpu.proto.client import AntidoteClient
+
+        try:
+            oc = AntidoteClient(*owner_addr)
+            ost = oc.node_status()
+            oc.close()
+        except Exception as e:
+            log(f"cannot reach the owner at {args.follower_of}: {e!r}")
+            return 2
+        if args.shards is None:
+            args.shards = int(ost["n_shards"])
+        elif args.shards != int(ost["n_shards"]):
+            log(f"--shards {args.shards} conflicts with the owner's "
+                f"n_shards={ost['n_shards']}: a follower replicates "
+                "that exact store (drop the flag to adopt the shape)")
+            return 2
+        if args.max_dcs is None:
+            args.max_dcs = int(ost["max_dcs"])
+        elif args.max_dcs != int(ost["max_dcs"]):
+            log(f"--max-dcs {args.max_dcs} conflicts with the owner's "
+                f"max_dcs={ost['max_dcs']}")
+            return 2
+        args.dc_id = int(ost["dc_id"])
+
     shards, max_dcs = resolve_serve_shape(args.log_dir, args.shards,
                                           args.max_dcs)
     cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs,
@@ -107,11 +141,13 @@ def cmd_serve(args) -> int:
 
     interdc = None
     fabric = None
-    if args.interdc:
-        # geo-replication plane: a TCP fabric + DCReplica so protocol
-        # clients can bootstrap a DC mesh (GetConnectionDescriptor /
+    follower = None
+    if args.interdc or args.follower_of:
+        # geo-replication / follower plane: a TCP fabric + replica so
+        # protocol clients can bootstrap a DC mesh, and followers can
+        # subscribe + ship images (GetConnectionDescriptor /
         # ConnectToDCs on either dialect)
-        from antidote_tpu.interdc import DCReplica
+        from antidote_tpu.interdc import DCReplica, FollowerReplica
         from antidote_tpu.interdc.tcp import TcpFabric
 
         public = args.public_host
@@ -123,9 +159,19 @@ def cmd_serve(args) -> int:
             log("WARNING: binding inter-DC on a wildcard address with no "
                 "--public-host: connection descriptors will advertise the "
                 "bind address, which remote DCs cannot reach")
-        interdc = DCReplica(node, fabric, name=f"dc{args.dc_id}")
-        if recover:
-            interdc.restore_from_log()
+        if args.follower_of:
+            follower = FollowerReplica(
+                node, fabric,
+                name=(args.replica_name
+                      or f"follower-{args.dc_id}-{os.getpid()}"),
+                owner_client_addr=owner_addr,
+                park_s=max(0.0, args.follower_park_ms) / 1e3,
+                digest_every_s=args.divergence_check_s,
+            )
+        else:
+            interdc = DCReplica(node, fabric, name=f"dc{args.dc_id}")
+            if recover:
+                interdc.restore_from_log()
     sup = Supervisor(on_giveup=lambda name: os._exit(70))
     if fabric is not None:
         # the replication drain loop runs as a SUPERVISED child: a pump
@@ -154,6 +200,7 @@ def cmd_serve(args) -> int:
             epoch_tick_ms=args.epoch_tick_ms,
             snapshot_cache_size=args.snapshot_cache_size,
             group_commit_window_us=args.group_commit_window_us,
+            follower=follower,
         )
         return server_box["srv"]
 
@@ -173,11 +220,28 @@ def cmd_serve(args) -> int:
                 stop=stop_metrics)
     sup.start()
     server = server_box["srv"]
+    ready: dict = {"host": server.host, "port": server.port, "ready": True}
+    if follower is not None:
+        # attach AFTER the fabric pump + server are supervised: the
+        # bootstrap ships the owner's image, catches the tail up, then
+        # subscribes — only then is the ready line printed, so drivers
+        # can gate on a SERVING follower
+        from antidote_tpu.proto.client import AntidoteClient
+
+        oc = AntidoteClient(*owner_addr)
+        desc = oc.get_connection_descriptor()
+        oc.close()
+        follower.client_addr = (args.public_host or server.host,
+                                server.port)
+        mode = follower.attach(desc)
+        ready.update({"role": "follower", "bootstrap": mode,
+                      "name": follower.name})
+        log(f"follower {follower.name} of {args.follower_of} serving "
+            f"(bootstrap mode={mode})")
     log(f"antidote_tpu dc{args.dc_id} serving on "
         f"{server.host}:{server.port} (recovered={recover}, "
         f"keys={len(node.store.directory)})")
-    print(json.dumps({"host": server.host, "port": server.port,
-                      "ready": True}), flush=True)
+    print(json.dumps(ready), flush=True)
     try:
         while True:
             time.sleep(3600)
@@ -315,6 +379,48 @@ def cmd_inspect_checkpoint(args) -> int:
     return 0
 
 
+def cmd_replica_status(args) -> int:
+    """Replica-plane view: against an owner, every known follower with
+    its typed state (ok | lagging | down | bootstrapping | healing) and
+    applied-VC lag; against a follower, its own state/bootstrap/
+    divergence view.  Exit 1 when any follower is not ok."""
+    c = _client(args)
+    out = c.replica_admin("status")
+    c.close()
+    print(json.dumps(out, indent=2))
+    bad = [n for n, f in (out.get("followers") or {}).items()
+           if f.get("state") != "ok"]
+    if out.get("role") == "follower" and out.get("state") != "serving":
+        bad.append(out.get("name"))
+    return 1 if bad else 0
+
+
+def cmd_replica_add(args) -> int:
+    """Pre-register an expected follower with the owner (it shows as
+    "down" until its first liveness report; also clears a prior
+    remove's decommission tombstone)."""
+    c = _client(args)
+    addr = None
+    if args.addr:
+        h, p = args.addr.rsplit(":", 1)
+        addr = (h, int(p))
+    out = c.replica_admin("add", name=args.name, addr=addr)
+    c.close()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_replica_remove(args) -> int:
+    """Decommission a follower at the owner: dropped from the registry
+    and its future liveness reports are refused (shut the follower
+    process down separately)."""
+    c = _client(args)
+    out = c.replica_admin("remove", name=args.name)
+    c.close()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def _member_rpc(args):
     from antidote_tpu.cluster.rpc import RpcClient
 
@@ -414,6 +520,26 @@ def main(argv=None) -> int:
                     help="attach the inter-DC replication plane (TCP "
                          "fabric + replica) so clients can bootstrap a "
                          "DC mesh over the protocol")
+    sv.add_argument("--follower-of", default=None, metavar="HOST:PORT",
+                    help="boot as a READ REPLICA of the owner serving at "
+                         "HOST:PORT (its client protocol port; the owner "
+                         "must run --interdc): bootstraps from the "
+                         "owner's checkpoint image / WAL tail, subscribes "
+                         "to its txn stream, serves session reads, "
+                         "refuses writes with a typed redirect.  "
+                         "Requires --log-dir; adopts the owner's shape")
+    sv.add_argument("--replica-name", default=None,
+                    help="follower name in the owner's replica registry "
+                         "(default: follower-<dc>-<pid>)")
+    sv.add_argument("--follower-park-ms", type=float, default=100.0,
+                    help="how long a session read parks for the applied "
+                         "clock to catch its token before the typed "
+                         "lagging redirect")
+    sv.add_argument("--divergence-check-s", type=float, default=5.0,
+                    help="cadence of the follower's round-robin per-shard "
+                         "digest comparison against the owner (detects "
+                         "silent divergence; a mismatch re-bootstraps "
+                         "from the image).  <= 0 disables")
     sv.add_argument("--interdc-port", type=int, default=0,
                     help="fixed listen port for the inter-DC fabric "
                          "(0 = ephemeral; fix it to publish through a "
@@ -505,6 +631,36 @@ def main(argv=None) -> int:
     cn.add_argument("--host", default="127.0.0.1")
     cn.add_argument("--port", type=int, default=8087)
     cn.set_defaults(fn=cmd_checkpoint_now)
+
+    # follower-replica registry (ISSUE 9): add/remove/status against an
+    # owner's replica plane (status also answers on a follower itself)
+    rs = sub.add_parser("replica-status",
+                        help="follower fleet health: typed ok/lagging/"
+                             "down states, applied-VC lag, bootstrap "
+                             "counts (exit 1 when any follower is "
+                             "unhealthy)")
+    rs.add_argument("--host", default="127.0.0.1")
+    rs.add_argument("--port", type=int, default=8087)
+    rs.set_defaults(fn=cmd_replica_status)
+
+    ra = sub.add_parser("replica-add",
+                        help="pre-register an expected follower with the "
+                             "owner (shows 'down' until it reports)")
+    ra.add_argument("--host", default="127.0.0.1")
+    ra.add_argument("--port", type=int, default=8087)
+    ra.add_argument("--name", required=True)
+    ra.add_argument("--addr", default=None,
+                    help="the follower's client endpoint host:port "
+                         "(informational, shown in status)")
+    ra.set_defaults(fn=cmd_replica_add)
+
+    rr = sub.add_parser("replica-remove",
+                        help="decommission a follower at the owner "
+                             "(future reports from the name refused)")
+    rr.add_argument("--host", default="127.0.0.1")
+    rr.add_argument("--port", type=int, default=8087)
+    rr.add_argument("--name", required=True)
+    rr.set_defaults(fn=cmd_replica_remove)
 
     ic = sub.add_parser("inspect-checkpoint",
                         help="offline checkpoint inspection: published "

@@ -117,6 +117,18 @@ class FaultPlan:
                                                        coordinator)
     ckpt.rename         checkpoint name                atomic publish
                                                        rename
+    ckpt.ship           checkpoint name (ckpt_N)       follower image
+                                                       shipping (per
+                                                       fetched chunk:
+                                                       delay holds the
+                                                       shipper mid-image
+                                                       so chaos can kill
+                                                       a follower mid-
+                                                       bootstrap; error/
+                                                       io_error/enospc
+                                                       fail the fetch —
+                                                       the follower's
+                                                       bootstrap retries)
     native_pump.load    None                           native receive plane
     ==================  =============================  =================
     """

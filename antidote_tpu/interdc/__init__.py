@@ -1,5 +1,7 @@
+from antidote_tpu.interdc.follower import FollowerReplica
 from antidote_tpu.interdc.messages import Descriptor, TxnMessage
 from antidote_tpu.interdc.replica import DCReplica
 from antidote_tpu.interdc.transport import LoopbackHub
 
-__all__ = ["Descriptor", "TxnMessage", "DCReplica", "LoopbackHub"]
+__all__ = ["Descriptor", "TxnMessage", "DCReplica", "FollowerReplica",
+           "LoopbackHub"]

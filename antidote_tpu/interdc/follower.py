@@ -1,0 +1,710 @@
+"""FollowerReplica — a fault-first read replica of one owner node.
+
+The follower read tier (ISSUE 9 / ROADMAP item 2): a follower is a full
+store replica of ONE owner (same ``dc_id``, same deployment shape) that
+subscribes to the owner's txn stream, applies effects through the same
+chain/causal-gate machinery a geo peer uses — including the owner's
+OWN-origin chain, which a peer DC skips — and serves epoch-plane
+snapshot reads from its own wire server with zero owner load.  It is
+built to *survive anything*:
+
+  * **bootstrap from nothing** — a fresh follower ships the owner's
+    newest checkpoint image over the request channel (``ckpt_meta`` /
+    ``ckpt_fetch``, fault site ``ckpt.ship``), installs it, checkpoints
+    it LOCALLY (so its own crash recovery is self-sufficient), then
+    catches the WAL tail up through the ordinary opid-gap machinery;
+  * **fall below the compaction floor and repair** — a catch-up refused
+    with the owner's "below the compaction floor" error (PR 7's
+    residual) no longer strands the replica: it re-bootstraps from the
+    current image (mode ``delta``) instead of retrying forever;
+  * **crash and rejoin fast** — a restarted follower recovers from its
+    own WAL + local checkpoint images, re-derives its chain positions,
+    and only replays the missed suffix (mode ``tail``);
+  * **diverge and self-heal** — per-shard content digests are
+    periodically compared against the owner at EQUAL applied clocks
+    (equal clocks ⇒ equal applied prefixes ⇒ digests must match); a
+    mismatch quarantines the replica (session reads get typed
+    redirects, never the corrupt value) and re-bootstraps from the
+    image;
+  * **never lie to a session** — reads carrying a session token (the
+    client's causal clock) are gated on the PER-SHARD applied clocks of
+    the shards they touch: the follower parks briefly, then answers a
+    typed :class:`~antidote_tpu.overload.ReplicaLagging` redirect so
+    the client fails over (across followers, and back to the owner)
+    with read-your-writes and monotonic reads intact.
+
+Scope: the follower follows a single-member owner DC's own-origin
+chain.  A geo-replicated owner's remote-origin effects reach the
+follower only through image bootstraps (their live chains are not
+re-published by the owner) — wiring followers into a full DC mesh is a
+recorded residual.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.interdc.messages import Descriptor
+from antidote_tpu.interdc.replica import DCReplica
+from antidote_tpu.store.kv import KVStore, freeze_key, key_to_shard, shard_digest
+
+log = logging.getLogger(__name__)
+
+
+class FollowerReplica(DCReplica):
+    """Read-only follower of one owner node (see module docstring)."""
+
+    #: default fabric-id base for followers — far above any dc id, so a
+    #: follower's endpoint never shadows a DC's on a shared fabric/test
+    FID_BASE = 1 << 14
+    #: session reads park at most this long for the applied clock to
+    #: catch the token before the typed redirect
+    PARK_S = 0.10
+    #: liveness-report cadence to the owner (the follower half of the
+    #: heartbeat/ping plane; the owner marks a silent follower DOWN
+    #: after DCReplica.REPLICA_DOWN_S)
+    REPORT_EVERY_S = 1.0
+    #: backoff between retries of a FAILED quarantine repair (owner
+    #: unreachable / image retired mid-ship) — retried from the tick
+    HEAL_RETRY_S = 2.0
+
+    def __init__(self, node: AntidoteNode, hub, name: str = "",
+                 owner_client_addr=None, fabric_id: Optional[int] = None,
+                 park_s: Optional[float] = None,
+                 digest_every_s: float = 0.0):
+        if fabric_id is None:
+            import os
+
+            fabric_id = self.FID_BASE + (os.getpid() % self.FID_BASE)
+        super().__init__(node, hub, name or f"follower-{fabric_id}",
+                         fabric_id=fabric_id)
+        #: the owner's client-protocol endpoint (host, port) carried in
+        #: every typed redirect, so session clients can re-route
+        self.owner_client_addr = (tuple(owner_client_addr)
+                                  if owner_client_addr else None)
+        self.park_s = self.PARK_S if park_s is None else float(park_s)
+        #: <= 0 disables the periodic divergence sweep (tests call
+        #: :meth:`check_divergence` directly; console arms it)
+        self.digest_every_s = float(digest_every_s)
+        #: owner's fabric id — set by :meth:`attach`
+        self.owner_fid: Optional[int] = None
+        #: bootstrapping -> serving -> (healing -> serving)*; anything
+        #: but "serving" redirects every session read
+        self.state = "bootstrapping"
+        #: completed bootstrap/repair cycles and the last cycle's mode
+        self.boots = 0
+        self.last_bootstrap_mode: Optional[str] = None
+        self.divergence_counts: Dict[str, int] = {
+            "ok": 0, "skipped": 0, "mismatch": 0}
+        self._boot_lock = threading.RLock()
+        self._in_heal = False
+        #: a failed quarantine repair parks its mode here; the tick
+        #: retries it on HEAL_RETRY_S backoff (never stuck "healing")
+        self._heal_pending: Optional[str] = None
+        self._heal_retry_at = 0.0
+        self._last_report = 0.0
+        self._last_digest = time.monotonic()
+        self._digest_rr = 0
+
+    # -- identity overrides ---------------------------------------------
+    def _ingest_own_origin(self) -> bool:
+        return True  # the owner's own chain IS the follower's data plane
+
+    def _on_local_commit(self, effects, commit_vc, origin) -> None:
+        # a follower is read-only by contract (the wire server refuses
+        # writes with typed not_owner): a local commit reaching this
+        # listener means an embedder bypassed it — the effects applied
+        # locally but are invisible to the owner and every other
+        # follower, i.e. guaranteed divergence (which the digest sweep
+        # will then catch).  Scream, don't publish.
+        log.error("follower %s observed a LOCAL commit (%d effect(s)) — "
+                  "followers are read-only; this state WILL diverge from "
+                  "the owner until the next digest check heals it",
+                  self.name, len(effects))
+
+    def heartbeat(self, exclude=frozenset()) -> None:
+        return  # followers never publish safe times — they consume them
+
+    def maybe_heartbeat(self) -> None:
+        """The follower's tick (runs at every fabric pump): periodic
+        liveness/lag report to the owner, plus — when armed — the
+        round-robin divergence sweep, one shard per cadence window."""
+        now = time.monotonic()
+        if now - self._last_report >= self.REPORT_EVERY_S:
+            self._last_report = now
+            self._send_report()
+        if (self._heal_pending is not None and not self._in_heal
+                and now >= self._heal_retry_at):
+            mode, self._heal_pending = self._heal_pending, None
+            self._heal(mode)  # re-parks itself on failure
+        if (self.digest_every_s > 0 and self.state == "serving"
+                and now - self._last_digest >= self.digest_every_s):
+            self._last_digest = now
+            shard = self._digest_rr % self.node.cfg.n_shards
+            self._digest_rr += 1
+            self.check_divergence([shard])
+
+    # -- attach / bootstrap ---------------------------------------------
+    def attach(self, desc) -> str:
+        """Wire this follower to its owner from a connection descriptor
+        (the owner's ``GET_CONNECTION_DESCRIPTOR`` reply): learn the
+        endpoint, bootstrap (image / delta / tail), subscribe to the txn
+        stream, and close the bootstrap→subscribe window with one more
+        catch-up.  Returns the bootstrap mode."""
+        if isinstance(desc, dict):
+            desc = Descriptor.from_wire(desc)
+        self.owner_fid = (desc.fabric_id if desc.fabric_id is not None
+                          else desc.dc_id)
+        assert self.owner_fid != self.fabric_id, \
+            "follower fabric id collides with the owner's"
+        # every chain's catch-up (and every request) goes to the owner
+        self.route_query = lambda origin, shard: self.owner_fid
+        if desc.address is not None:
+            connect = getattr(self.hub, "connect_remote", None)
+            if connect is not None:
+                connect(self.owner_fid, desc.address[0],
+                        int(desc.address[1]))
+        mode = self.bootstrap()
+        self.hub.subscribe(self.fabric_id, self.owner_fid,
+                           self._on_message)
+        with self._boot_lock:
+            self._in_heal = True
+            try:
+                # the floor can advance inside the bootstrap→subscribe
+                # window too (aggressive checkpoint cadences): this
+                # catch-up repairs via image re-install like any other
+                if self._catch_up_all_repairing():
+                    self._finish_cycle("delta")
+                    mode = "delta"
+            finally:
+                self._in_heal = False
+        self._post_apply_publish(force=True)
+        self._send_report()
+        return mode
+
+    def bootstrap(self) -> str:
+        """One bootstrap cycle: image install for a blank follower (when
+        the owner has one), WAL catch-up otherwise; a catch-up refused
+        below the owner's compaction floor repairs via image re-install
+        (mode ``delta``).  Leaves the replica ``serving``."""
+        with self._boot_lock:
+            self._in_heal = True
+            try:
+                self.restore_from_log()
+                have_local = bool(self.node.store.directory) or bool(
+                    self.last_seen)
+                mode = "tail"
+                if not have_local:
+                    meta = self._owner_image_meta()
+                    if meta is not None:
+                        self._reinstall(meta)
+                        mode = "image"
+                # a position below the owner's floor (long-partitioned /
+                # blank-WAL follower — or the floor advancing again
+                # mid-repair) re-installs the image and retries
+                if self._catch_up_all_repairing() and mode != "image":
+                    mode = "delta"
+                self._finish_cycle(mode)
+                return mode
+            finally:
+                self._in_heal = False
+
+    def _finish_cycle(self, mode: str) -> None:
+        self._post_apply_publish(force=True)
+        self.boots += 1
+        self.last_bootstrap_mode = mode
+        m = getattr(self.node, "metrics", None)
+        if m is not None:
+            m.follower_bootstrap.inc(mode=mode)
+        self.state = "serving"
+        log.info("follower %s: bootstrap cycle complete (mode=%s, "
+                 "applied=%s)", self.name, mode,
+                 [int(x) for x in self.node.store.dc_max_vc()])
+
+    def _heal(self, mode: str) -> None:
+        """Quarantine-and-repair: stop serving sessions, re-install the
+        owner's current image, catch the tail up, resume.
+
+        A FAILED repair (owner unreachable mid-fetch, image retired by
+        retention mid-ship, persistent verification failure) must not
+        quarantine the replica forever OR crash the delivery pump: the
+        failure is swallowed here, the replica stays ``healing`` (its
+        store may be mid-wipe — sessions keep redirecting), and the
+        tick retries the pending repair on a short backoff until the
+        owner is reachable again."""
+        with self._boot_lock:
+            self.state = "healing"
+            self._in_heal = True
+            try:
+                self._reinstall()
+                self._catch_up_all_repairing()
+                self._finish_cycle(mode)
+                self._heal_pending = None
+            except Exception:
+                self._heal_pending = mode
+                self._heal_retry_at = (time.monotonic()
+                                       + self.HEAL_RETRY_S)
+                log.exception(
+                    "follower %s: repair (mode=%s) failed; staying "
+                    "quarantined and retrying from the tick", self.name,
+                    mode)
+            finally:
+                self._in_heal = False
+
+    def _catch_up_all_repairing(self, attempts: int = 3) -> bool:
+        """Catch every chain up, re-installing the owner's image
+        whenever the position is below the compaction floor — which can
+        happen AGAIN mid-repair (the owner keeps checkpointing).
+        Returns True if any (re)install happened.  Caller holds
+        ``_boot_lock`` with ``_in_heal`` set."""
+        reinstalled = False
+        last: Optional[BaseException] = None
+        for _attempt in range(attempts):
+            try:
+                self._catch_up_all()
+                return reinstalled
+            except RuntimeError as e:
+                if "compaction floor" not in str(e):
+                    raise
+                log.warning("follower %s below the owner's compaction "
+                            "floor; repairing from the checkpoint image",
+                            self.name)
+                last = e
+                self._reinstall()
+                reinstalled = True
+        raise last  # type: ignore[misc]
+
+    def restore_from_log(self) -> None:
+        """Reseed the CONSUMED chain positions from the local WAL +
+        installed chain floors — the follower twin of the peer replica's
+        restore (a follower tracks the owner's own-origin chain as a
+        consumer too, and never rebuilds an egress window)."""
+        store = self.node.store
+        if store.log is None:
+            return
+        for shard in sorted(self.shards):
+            counts: Dict[int, int] = {}
+            for origin in range(self.node.cfg.max_dcs):
+                base = store.log.chain_base(shard, origin)
+                if base:
+                    counts[origin] = base
+            for origin, _vc, _effs in self._wal_txn_groups(
+                    shard, my_effects_after=1 << 62):
+                counts[origin] = counts.get(origin, 0) + 1
+            for origin, n in counts.items():
+                key = (origin, shard)
+                if n > self.last_seen.get(key, 0):
+                    self.last_seen[key] = n
+
+    # -- image shipping --------------------------------------------------
+    def _owner_image_meta(self, before_id: Optional[int] = None
+                          ) -> Optional[dict]:
+        body = {} if before_id is None else {"before_id": int(before_id)}
+        return self.hub.request(self.owner_fid, "ckpt_meta", body)
+
+    def _fetch_image(self, meta: dict) -> dict:
+        """Ship the owner's image in chunks over the request channel and
+        verify size + CRC before decoding — a truncated or bit-rotted
+        ship must fail loudly, never install."""
+        import zlib
+
+        from antidote_tpu.store.handoff import unpack
+
+        size = int(meta["image_bytes"])
+        buf = bytearray()
+        while len(buf) < size:
+            r = self.hub.request(self.owner_fid, "ckpt_fetch", {
+                "id": int(meta["id"]), "off": len(buf),
+                "n": DCReplica.CKPT_SHIP_CHUNK,
+            })
+            data = bytes(r["data"])
+            if not data:
+                break
+            buf.extend(data)
+            if r.get("eof"):
+                break
+        data = bytes(buf)
+        if (len(data) != size
+                or (zlib.crc32(data) & 0xFFFFFFFF)
+                != int(meta["image_crc32"])):
+            raise RuntimeError(
+                f"shipped checkpoint image ckpt_{meta['id']} failed "
+                f"verification ({len(data)}/{size} bytes)"
+            )
+        return unpack(data)
+
+    def _reinstall(self, meta: Optional[dict] = None) -> None:
+        """Discard local state and install the owner's newest image.
+
+        The store is REPLACED (fresh tables, same LogManager): the old
+        device state may be arbitrarily wrong (that's why we're here),
+        local WAL records and local checkpoint images derived from it
+        must not resurrect, and the epoch-id sequence continues so
+        snapshot-cache stamps never repeat.  Finishes with a LOCAL
+        checkpoint so the follower's own crash recovery covers the
+        installed prefix (its WAL only ever holds the tail).
+
+        ``meta``: an already-resolved ``ckpt_meta`` reply (bootstrap
+        passes the one it decided on, saving a round trip).  The fetch
+        RETRIES against freshly-resolved metadata: the owner's
+        retention sweep can retire the image we were shipping mid-fetch
+        (FileNotFoundError / short read at the owner), and the cure is
+        simply the newer image."""
+        from antidote_tpu.log import checkpoint as _ckpt
+        from antidote_tpu.log.checkpoint import install_image
+
+        image = None
+        last: Optional[BaseException] = None
+        for _attempt in range(3):
+            if meta is None:
+                meta = self._owner_image_meta()
+            if meta is None:
+                raise RuntimeError(
+                    "owner has no published checkpoint image to "
+                    "bootstrap from (run checkpoint-now on the owner, "
+                    "or size its --checkpoint-interval-s below the "
+                    "follower's outage)"
+                )
+            try:
+                image = self._fetch_image(meta)
+                break
+            except (RuntimeError, OSError) as e:
+                log.warning("follower %s: image ckpt_%s fetch failed "
+                            "(%s); falling back to an older retained "
+                            "image (else re-resolving the newest)",
+                            self.name, meta.get("id"), e)
+                last = e
+                # the newest image may be corrupt on the owner's disk
+                # (bit rot — the same case owner-side recovery falls
+                # back for) or retired mid-ship: prefer the next OLDER
+                # retained image, else re-resolve the newest (a fresh
+                # one may have published meanwhile)
+                try:
+                    meta = self._owner_image_meta(
+                        before_id=meta.get("id"))
+                except Exception:
+                    meta = None
+        if image is None:
+            raise RuntimeError(
+                "checkpoint image shipping failed repeatedly"
+            ) from last
+        node, txm = self.node, self.node.txm
+        cfg = node.cfg
+        with txm.commit_lock:
+            old = node.store
+            logm = old.log
+            assert logm is not None, \
+                "a follower needs a durable log (log_dir) to bootstrap"
+            _ckpt.discard_all(logm.dir)
+            for shard in range(cfg.n_shards):
+                logm.truncate_shard(shard)
+            # adopt the OWNER's truncation epochs: ours were just bumped
+            # by the truncations above, and install_image would drop
+            # every imaged shard as stale against them
+            logm.adopt_shard_resets({
+                int(k): int(v)
+                for k, v in (image.get("shard_resets") or {}).items()
+            })
+            store = KVStore(cfg, sharding=old.sharding, log=logm)
+            store.metrics = getattr(node, "metrics", None)
+            # epoch ids continue: a reader-pinned epoch of the old store
+            # (or a stale snapshot-cache stamp) must never collide with
+            # a fresh id
+            store._serving_seq = old._serving_seq
+            old.drop_serving_epoch()
+            node.store = store
+            txm.store = store
+            txm.committed_keys = {}
+            txm.commit_counter = 0
+            txm.epoch_lag_counter = 0
+            install_image(store, txm, image)
+            # follower floor fixup: the install stamped the OWNER's WAL
+            # floors/seqs, but this WAL is freshly truncated — local
+            # appends must mint q from 1 and local replay must skip
+            # nothing (the image prefix is covered by the LOCAL
+            # checkpoint below; chain_floor stays — it both numbers the
+            # chains and keeps the compaction-horizon guard honest)
+            logm.floor_seqs[:] = 0
+            logm.seqs[:] = 0
+            # chain positions restart at the image's floors; anything
+            # gated/pending against the old store is garbage now
+            self.last_seen.clear()
+            self.pending.clear()
+            self.gate.clear()
+            for shard in range(cfg.n_shards):
+                for origin in range(cfg.max_dcs):
+                    base = logm.chain_base(shard, origin)
+                    if base:
+                        self.last_seen[(origin, shard)] = base
+            self._sync_counter_locked()
+        self._local_checkpoint()
+
+    def _local_checkpoint(self) -> None:
+        """Checkpoint the freshly-installed state locally.  The node's
+        checkpointer (if any) captured the PRE-swap store — rebuild it
+        against the new one, keeping its cadence."""
+        node = self.node
+        cp = node.checkpointer
+        interval, retain = 0.0, 2
+        if cp is not None:
+            interval, retain = cp.interval_s, cp.retain
+            cp.stop()
+            node.checkpointer = None
+        node.start_checkpointer(interval_s=interval, retain=retain)
+        node.checkpointer.checkpoint_now()
+
+    # -- chain catch-up ---------------------------------------------------
+    def _catch_up_all(self) -> None:
+        """Pull every shard's own-origin chain suffix from the owner —
+        bootstrap's bulk path and the subscribe-window closer; steady
+        state uses the ordinary ping-revealed gap machinery."""
+        for shard in sorted(self.shards):
+            key = (self.dc_id, shard)
+            super()._catch_up(key, self.last_seen.get(key, 0))
+        # the replayed suffix sits in the causal gate: drain it NOW (the
+        # steady-state drain runs on stream deliveries, which a replica
+        # mid-bootstrap/heal has none of) — _drain_gates also republishes
+        # the applied-stamped epoch via the override below
+        self._drain_gates()
+
+    def _catch_up(self, key, from_opid) -> None:
+        """The runtime repair hook: a catch-up refused below the owner's
+        compaction floor triggers a delta re-bootstrap instead of
+        retrying (and failing) on every subsequent ping forever."""
+        try:
+            super()._catch_up(key, from_opid)
+        except RuntimeError as e:
+            if "compaction floor" not in str(e) or self._in_heal:
+                raise
+            log.warning("follower %s: catch-up for chain %s fell below "
+                        "the owner's compaction floor; re-bootstrapping "
+                        "from the image (%s)", self.name, key, e)
+            self._heal("delta")
+
+    # -- applied-VC-stamped serving epochs --------------------------------
+    #: drain-path epoch publishes are rate-limited to one per window —
+    #: each publish is a device re-freeze, and a follower fleet paying
+    #: one per delivered write batch per replica was the dominant fixed
+    #: cost at high fanout.  Freshness doesn't ride on it: the server's
+    #: epoch ticker republishes every --epoch-tick-ms, and the session
+    #: gate publishes ON DEMAND (bypassing this limit) whenever a
+    #: token needs an epoch the current one can't prove it covers.
+    EPOCH_PUBLISH_MIN_S = 0.025
+
+    def _drain_gates(self) -> None:
+        super()._drain_gates()
+        self._post_apply_publish()
+
+    def _post_apply_publish(self, force: bool = False) -> None:
+        txm = self.node.txm
+        with txm.commit_lock:
+            self._sync_counter_locked()
+            now = time.monotonic()
+            if (force or now - getattr(self, "_last_epoch_pub", 0.0)
+                    >= self.EPOCH_PUBLISH_MIN_S):
+                self._last_epoch_pub = now
+                self.publish_applied_epoch_locked()
+
+    def _sync_counter_locked(self) -> None:
+        """Slave the (otherwise unused) commit counter to the applied
+        own-lane clock: the locked read path and `serving_epoch_vc`
+        derive the own-lane snapshot from it, and a follower's truth is
+        exactly what it has applied."""
+        txm = self.node.txm
+        own = int(self.node.store.dc_max_vc()[self.dc_id])
+        if own > txm.commit_counter:
+            txm.commit_counter = own
+
+    def publish_applied_epoch_locked(self) -> str:
+        """The ONLY sanctioned epoch-publish path on follower planes
+        (tools/lint.py enforces it): commit_counter is slaved to the
+        applied clock first, so the published epoch's VC claims exactly
+        what this replica has applied — an epoch stamped ahead of the
+        applied clock is a silent causal-violation machine."""
+        txm = self.node.txm
+        if not txm.serving_epochs:
+            return "disabled"
+        # vc-stamped: commit_counter == applied own lane (synced above),
+        # so serving_epoch_vc IS the applied clock
+        return txm._publish_serving_epoch_locked()
+
+    # -- session gate ------------------------------------------------------
+    def gate_read(self, objects, clock, deadline: Optional[float] = None
+                  ) -> None:
+        """Admission gate for session reads on this follower: park until
+        the PER-SHARD applied clocks of every shard the read touches
+        cover the token, then make sure the serving epoch cannot claim
+        coverage it lacks; past the park window (or while not serving)
+        answer a typed redirect instead — never a stale read."""
+        from antidote_tpu.overload import ReplicaLagging
+
+        m = getattr(self.node, "metrics", None)
+        if self.state != "serving":
+            if m is not None:
+                m.session_redirects.inc(kind="lagging")
+            raise ReplicaLagging(
+                f"follower {self.name} is {self.state}",
+                retry_after_ms=250, redirect=self.owner_client_addr,
+            )
+        if clock is None:
+            return
+        cfg = self.node.cfg
+        vec = np.zeros(cfg.max_dcs, np.int64)
+        cl = np.asarray(clock, np.int64)[:cfg.max_dcs]
+        vec[:len(cl)] = cl
+        shards = sorted({
+            key_to_shard(freeze_key(k), b, cfg.n_shards)
+            for (k, _t, b) in objects
+        })
+        end = time.monotonic() + self.park_s
+        if deadline is not None:
+            end = min(end, deadline)
+        while True:
+            store = self.node.store  # a heal may swap it mid-park
+            if self.state != "serving":
+                break
+            if all((store.applied_vc[s] >= vec).all() for s in shards):
+                self._ensure_epoch_covers(store, shards, vec)
+                return
+            if time.monotonic() >= end:
+                break
+            time.sleep(0.002)
+        if m is not None:
+            m.session_redirects.inc(kind="lagging")
+        raise ReplicaLagging(
+            f"follower {self.name} applied clock is behind the session "
+            f"token after a {int(self.park_s * 1e3)} ms park",
+            retry_after_ms=50, redirect=self.owner_client_addr,
+        )
+
+    def _ensure_epoch_covers(self, store, shards: List[int],
+                             vec: np.ndarray) -> None:
+        """The epoch-plane half of the gate: the live applied clocks
+        cover the token, but the FROZEN serving epoch may predate the
+        covering applies while its (cross-shard max) VC still claims the
+        token — ping-skewed lanes make that possible.  Each epoch
+        records the applied-clock cut it was captured at; when the
+        current epoch would claim the token without covering it on the
+        target shards, publish a fresh one (which captures the live,
+        covering cut)."""
+        from antidote_tpu.overload import ReplicaLagging
+
+        for _attempt in range(2):
+            ep = store.serving_epoch
+            if ep is None:
+                return  # no epoch: reads take the (live) locked path
+            if not (vec[:len(ep.vc)] <= np.asarray(ep.vc, np.int64)).all():
+                return  # epoch won't claim the token: locked path serves
+            app = getattr(ep, "applied", None)
+            if app is None or all((app[s] >= vec).all() for s in shards):
+                return
+            with self.node.txm.commit_lock:
+                self.publish_applied_epoch_locked()
+        m = getattr(self.node, "metrics", None)
+        if m is not None:
+            m.session_redirects.inc(kind="lagging")
+        raise ReplicaLagging(
+            f"follower {self.name} could not refresh its serving epoch "
+            "to cover the session token (publish deferred)",
+            retry_after_ms=50, redirect=self.owner_client_addr,
+        )
+
+    # -- divergence detection ---------------------------------------------
+    def check_divergence(self, shards=None) -> Dict[int, str]:
+        """Compare per-shard content digests against the owner at EQUAL
+        applied clocks.  ``skipped`` = clocks unequal (replication in
+        flight — nothing comparable, retried next sweep); ``ok`` =
+        digests match; ``mismatch`` = silent corruption — the follower
+        quarantines itself and re-bootstraps from the owner's image
+        before serving another session read."""
+        m = getattr(self.node, "metrics", None)
+        out: Dict[int, str] = {}
+        for shard in (range(self.node.cfg.n_shards)
+                      if shards is None else shards):
+            shard = int(shard)
+            try:
+                reply = self.hub.request(self.owner_fid, "shard_digest",
+                                         {"shard": shard})
+            except Exception as e:
+                log.warning("follower %s: divergence check for shard %d "
+                            "unreachable (%r)", self.name, shard, e)
+                out[shard] = "unreachable"
+                continue
+            store = self.node.store
+            with self.node.txm.commit_lock:
+                mine_vc = [int(x) for x in store.applied_vc[shard]]
+                if mine_vc != [int(x) for x in reply["vc"]]:
+                    result = "skipped"
+                    mine = None
+                else:
+                    mine = shard_digest(store, shard)
+                    result = ("ok" if mine == reply["digest"]
+                              else "mismatch")
+            self.divergence_counts[result] = (
+                self.divergence_counts.get(result, 0) + 1)
+            if m is not None:
+                m.divergence_checks.inc(result=result)
+            out[shard] = result
+            if result == "mismatch":
+                log.error(
+                    "follower %s DIVERGED from the owner on shard %d at "
+                    "applied clock %s (digest %s != %s): quarantining "
+                    "and re-bootstrapping from the checkpoint image",
+                    self.name, shard, mine_vc, mine, reply["digest"],
+                )
+                self._heal("image")
+                break
+        return out
+
+    # -- liveness / status -------------------------------------------------
+    def _send_report(self) -> None:
+        if self.owner_fid is None:
+            return
+        try:
+            self.hub.request(self.owner_fid, "follower_report", {
+                "name": self.name,
+                "applied": [int(x) for x in self.node.store.dc_max_vc()],
+                "addr": (list(self.client_addr)
+                         if getattr(self, "client_addr", None) else None),
+                "state": self.state,
+                "boots": self.boots,
+            })
+        except Exception:
+            # the owner is unreachable (partition / restart): the
+            # subscription reconnect machinery owns the healing; the
+            # owner meanwhile marks this follower DOWN by report age
+            now = time.monotonic()
+            if now - getattr(self, "_last_report_warn", 0.0) > 5.0:
+                self._last_report_warn = now
+                log.warning("follower %s: liveness report to the owner "
+                            "failed; will keep retrying", self.name)
+
+    def replica_status(self) -> dict:
+        return {
+            "role": "follower",
+            "name": self.name,
+            "state": self.state,
+            "owner": (list(self.owner_client_addr)
+                      if self.owner_client_addr else None),
+            "applied": [int(x) for x in self.node.store.dc_max_vc()],
+            "boots": self.boots,
+            "last_bootstrap_mode": self.last_bootstrap_mode,
+            "divergence": dict(self.divergence_counts),
+        }
+
+    def replica_admin(self, body: dict) -> dict:
+        if body.get("op", "status") == "status":
+            return self.replica_status()
+        raise RuntimeError(
+            "replica add/remove are owner operations; this node is a "
+            "follower"
+        )
+
+
+__all__ = ["FollowerReplica"]

@@ -55,6 +55,15 @@ class DCReplica:
     #: ``last_seen`` and gets refetched).
     GATE_HWM = 1024
     PENDING_HWM = 256
+    #: follower liveness (ISSUE 9): a follower whose last report is
+    #: older than this is DOWN; one whose applied own-lane clock trails
+    #: the owner's commit counter by more than REPLICA_LAG_OPS is
+    #: LAGGING (both surface typed in node status / console)
+    REPLICA_DOWN_S = 5.0
+    REPLICA_LAG_OPS = 1024
+    #: image-shipping chunk for ckpt_fetch (one request per chunk; the
+    #: ckpt.ship fault site is consulted per chunk)
+    CKPT_SHIP_CHUNK = 4 << 20
 
     def __init__(self, node: AntidoteNode, hub: LoopbackHub, name: str = "",
                  shards=None, fabric_id: int = None):
@@ -146,6 +155,14 @@ class DCReplica:
         )
         #: clustered DCs install an intra-DC router here (attach_interdc)
         self.transfer_handler = None
+        #: follower registry (ISSUE 9): name -> {addr, applied, state,
+        #: at (monotonic of last report), boots}; reports arrive on the
+        #: request channel (follower_report), operators pre-register /
+        #: decommission via the wire REPLICA_ADMIN op
+        self.followers: Dict[str, dict] = {}
+        #: decommissioned follower names whose reports are ignored
+        self._removed_followers: set = set()
+        self._followers_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # restart (check_node_restart, /root/reference/src/inter_dc_manager.erl:156-206)
@@ -562,6 +579,15 @@ class DCReplica:
             )
         if kind == "check_up":
             return True
+        # follower-replica plane (ISSUE 9)
+        if kind == "ckpt_meta":
+            return self._serve_ckpt_meta(payload)
+        if kind == "ckpt_fetch":
+            return self._serve_ckpt_fetch(payload)
+        if kind == "shard_digest":
+            return self._serve_shard_digest(payload)
+        if kind == "follower_report":
+            return self._serve_follower_report(payload)
         raise ValueError(f"unknown request kind {kind!r}")
 
     def bcounter_tick(self) -> int:
@@ -635,6 +661,156 @@ class DCReplica:
             "is attached to serve it"
         )
 
+    def _ingest_own_origin(self) -> bool:
+        """Whether this endpoint applies messages of its OWN dc lane —
+        False for peer replicas (they minted that chain), True for
+        follower replicas (interdc/follower.py), whose whole data plane
+        is the owner's own-origin chain."""
+        return False
+
+    # ------------------------------------------------------------------
+    # follower replica plane (ISSUE 9): image shipping, digests,
+    # liveness registry — all served on the existing request channel
+    # ------------------------------------------------------------------
+    def _serve_ckpt_meta(self, payload=None) -> "dict | None":
+        """Newest published checkpoint image's shippable metadata, or
+        None (no durable log / nothing published yet — the follower
+        falls back to a whole-chain WAL catch-up).  ``before_id`` in the
+        payload restricts to strictly older retained images (follower
+        fallback past a corrupt newest)."""
+        from antidote_tpu.log import checkpoint as _ckpt
+
+        wlog = self.node.store.log
+        if wlog is None:
+            return None
+        before = (payload or {}).get("before_id")
+        return _ckpt.latest_image_meta(wlog.dir, before_id=before)
+
+    def _serve_ckpt_fetch(self, payload) -> dict:
+        """One chunk of a published image (``{id, off, n}`` ->
+        ``{data, eof}``) — the image-shipping RPC that closes the
+        compaction-floor residual: a peer below the floor installs the
+        image instead of being refused.  Fault site ``ckpt.ship`` is
+        consulted per chunk (chaos holds/kills the shipper mid-image)."""
+        import errno as _errno
+
+        from antidote_tpu import faults as _faults
+        from antidote_tpu.log import checkpoint as _ckpt
+
+        wlog = self.node.store.log
+        assert wlog is not None, "ckpt_fetch on a log-less node"
+        ckpt_id = int(payload["id"])
+        d = _faults.hit("ckpt.ship", key=f"ckpt_{ckpt_id}")
+        if d is not None:
+            if d.action == "delay" and d.arg:
+                time.sleep(float(d.arg))
+            elif d.action in ("error", "io_error", "enospc"):
+                raise OSError(_errno.EIO,
+                              f"injected fault: ckpt.ship ckpt_{ckpt_id}")
+        path = _ckpt.image_path(wlog.dir, ckpt_id)
+        off = int(payload.get("off", 0))
+        n = int(payload.get("n", self.CKPT_SHIP_CHUNK))
+        with open(path, "rb") as f:
+            size = f.seek(0, 2)
+            f.seek(off)
+            data = f.read(max(0, n))
+        return {"data": data, "eof": off + len(data) >= size}
+
+    def _serve_shard_digest(self, payload) -> dict:
+        """One shard's (applied clock, content digest) under the commit
+        lock — the comparable cut a follower checks its own digest
+        against.  Equal clocks ⇒ equal applied prefixes ⇒ the digests
+        must match; anything else is silent corruption."""
+        from antidote_tpu.store.kv import shard_digest
+
+        shard = int(payload["shard"])
+        store = self.node.store
+        with self.node.txm.commit_lock:
+            return {
+                "vc": [int(x) for x in store.applied_vc[shard]],
+                "digest": shard_digest(store, shard),
+            }
+
+    def _serve_follower_report(self, payload) -> dict:
+        """A follower's periodic liveness/lag report.  Decommissioned
+        names are refused (``accepted: False``) so a removed replica
+        can't silently re-register."""
+        name = str(payload.get("name", ""))
+        with self._followers_lock:
+            if name in self._removed_followers:
+                return {"accepted": False}
+            ent = self.followers.setdefault(name, {"boots": 0})
+            ent["applied"] = [int(x) for x in payload.get("applied") or []]
+            ent["addr"] = payload.get("addr")
+            ent["state"] = payload.get("state", "serving")
+            ent["boots"] = int(payload.get("boots", ent.get("boots", 0)))
+            ent["at"] = time.monotonic()
+        m = getattr(self.node, "metrics", None)
+        if m is not None and len(ent["applied"]) > self.dc_id:
+            lag = max(0, int(self.node.txm.commit_counter)
+                      - int(ent["applied"][self.dc_id]))
+            m.follower_lag.set(lag, follower=name)
+        return {"accepted": True,
+                "commit_counter": int(self.node.txm.commit_counter)}
+
+    def replica_status(self) -> dict:
+        """The node-status / console ``replica status`` block: every
+        known follower with its typed liveness state (ok | lagging |
+        down | its self-reported bootstrap state) and applied-VC lag."""
+        now = time.monotonic()
+        counter = int(self.node.txm.commit_counter)
+        out: Dict[str, dict] = {}
+        with self._followers_lock:
+            snap = {k: dict(v) for k, v in self.followers.items()}
+        for name, ent in sorted(snap.items()):
+            at = ent.get("at", 0.0)
+            applied = ent.get("applied") or []
+            lag = (max(0, counter - int(applied[self.dc_id]))
+                   if len(applied) > self.dc_id else None)
+            if not at or now - at > self.REPLICA_DOWN_S:
+                state = "down"
+            elif ent.get("state") not in (None, "serving"):
+                state = str(ent["state"])  # bootstrapping / healing
+            elif lag is not None and lag > self.REPLICA_LAG_OPS:
+                state = "lagging"
+            else:
+                state = "ok"
+            out[name] = {
+                "state": state,
+                "lag": lag,
+                "age_s": round(now - at, 2) if at else None,
+                "addr": ent.get("addr"),
+                "boots": ent.get("boots", 0),
+            }
+        return {"role": "owner", "followers": out}
+
+    def replica_admin(self, body: dict) -> dict:
+        """The wire REPLICA_ADMIN op (console replica add/remove/
+        status): add pre-registers an expected follower (shows "down"
+        until its first report and clears any decommission tombstone);
+        remove decommissions the name (its future reports are refused);
+        status returns :meth:`replica_status`."""
+        op = body.get("op", "status")
+        if op == "status":
+            return self.replica_status()
+        name = str(body["name"])
+        if op == "add":
+            with self._followers_lock:
+                self._removed_followers.discard(name)
+                ent = self.followers.setdefault(name, {"boots": 0})
+                if body.get("addr"):
+                    ent["addr"] = list(body["addr"])
+            return self.replica_status()
+        if op == "remove":
+            with self._followers_lock:
+                self.followers.pop(name, None)
+                self._removed_followers.add(name)
+            m = getattr(self.node, "metrics", None)
+            if m is not None:
+                m.follower_lag.set(0, follower=name)
+            return self.replica_status()
+        raise ValueError(f"unknown replica admin op {op!r}")
+
     # ------------------------------------------------------------------
     # ingress
     # ------------------------------------------------------------------
@@ -652,7 +828,9 @@ class DCReplica:
             log.warning("discarding undecodable inter-DC frame (%d bytes)",
                         len(data))
             return
-        if msg.origin == self.dc_id:
+        if msg.origin == self.dc_id and not self._ingest_own_origin():
+            # a peer DC never applies its own origin's chain (it minted
+            # it); a FOLLOWER of this dc_id does — that chain IS its data
             return
         # INGRESS STATE DISCIPLINE: last_seen/pending/gate mutate only
         # under the node's commit lock — the same lock the gate drain

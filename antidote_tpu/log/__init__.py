@@ -551,6 +551,19 @@ class LogManager:
         self._retired.extend(out)
         return out
 
+    def adopt_shard_resets(self, resets: Dict[int, int]) -> None:
+        """Durably REPLACE the per-shard truncation epochs with another
+        replica's (follower image bootstrap, ISSUE 9): the installed
+        image carries the OWNER's reset epochs, and keeping the
+        follower's own (bumped by its pre-bootstrap truncations) would
+        make a later :func:`~antidote_tpu.log.checkpoint.install_image`
+        of a LOCAL checkpoint drop every shard as stale.  Only valid
+        right after the local image set was discarded — the epochs exist
+        to fence exactly those images."""
+        self.shard_resets = {int(k): int(v) for k, v in resets.items()}
+        _set_dir_meta_key(self.dir, "shard_resets",
+                          {str(k): v for k, v in self.shard_resets.items()})
+
     def set_chain_floor(self, shard: int, counts) -> None:
         """Install one shard's replication-group base counts (handoff
         from a compacted source: the package carries the source's chain

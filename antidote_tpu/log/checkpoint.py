@@ -142,6 +142,60 @@ def load_latest(log_dir: str) -> Optional[Tuple[dict, dict]]:
     return None
 
 
+def latest_image_meta(log_dir: str,
+                      before_id: Optional[int] = None) -> Optional[dict]:
+    """Shippable metadata of the newest published checkpoint image —
+    what the owner answers a follower's ``ckpt_meta`` request with:
+    ``{id, image_bytes, image_crc32, stamp_vc_max, created_at}``.
+    Served straight from the manifest (never decodes the image).
+    ``before_id`` restricts to strictly older images — a follower whose
+    fetch of the newest image failed verification (bit rot) falls back
+    through the retention window exactly like owner-side recovery."""
+    cks = list_checkpoints(checkpoint_root(log_dir))
+    for _id, path in reversed(cks):
+        if before_id is not None and _id >= int(before_id):
+            continue
+        manifest = load_manifest(path)
+        if manifest is None:
+            continue
+        return {
+            "id": int(manifest["id"]),
+            "image_bytes": int(manifest["image_bytes"]),
+            "image_crc32": int(manifest["image_crc32"]),
+            "stamp_vc_max": manifest.get("stamp_vc_max"),
+            "created_at": manifest.get("created_at"),
+        }
+    return None
+
+
+def image_path(log_dir: str, ckpt_id: int) -> str:
+    """Path of a published image file by id (ckpt_fetch serving)."""
+    return os.path.join(checkpoint_root(log_dir), f"ckpt_{int(ckpt_id)}",
+                        _IMAGE)
+
+
+def discard_all(log_dir: str) -> int:
+    """Delete EVERY published checkpoint image under a log dir — the
+    diverged-follower repair path: a follower re-bootstrapping from the
+    owner's image must not let its own (possibly corrupt-derived) local
+    images resurrect at the next restart.  Owned by this module so the
+    deletion stays inside the guarded log/ lifecycle.  Returns the
+    number of images discarded."""
+    root = checkpoint_root(log_dir)
+    cks = list_checkpoints(root)
+    for _id, path in cks:
+        shutil.rmtree(path, ignore_errors=True)  # reclaim-ok: explicit
+        # whole-image discard before a follower re-bootstrap re-seeds
+        # the store from the owner's image
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.startswith("tmp."):
+                shutil.rmtree(os.path.join(root, name),
+                              ignore_errors=True)  # reclaim-ok: orphaned
+                # temp dir of a crashed writer
+    return len(cks)
+
+
 # ---------------------------------------------------------------------------
 # image install (recovery side)
 # ---------------------------------------------------------------------------

@@ -506,6 +506,39 @@ class NodeMetrics:
             "publishes and truncates nothing",
             ("status",),
         )
+        # follower read replicas & session tier (ISSUE 9): owner-side
+        # lag per follower, session redirects (park-then-redirect +
+        # not-owner write refusals), bootstrap/repair cycles by mode,
+        # and the divergence-detection comparisons
+        self.follower_lag = r.gauge(
+            "antidote_follower_applied_vc_lag",
+            "Owner-side commits the named follower's applied own-lane "
+            "clock trails the owner's commit counter by (from its last "
+            "liveness report)",
+            label_names=("follower",),
+        )
+        self.session_redirects = r.counter(
+            "antidote_session_redirects_total",
+            "Session requests a replica refused with a typed redirect "
+            "(lagging = applied clock behind the token after the park "
+            "window; not_owner = write/txn sent to a follower)",
+            ("kind",),
+        )
+        self.follower_bootstrap = r.counter(
+            "antidote_follower_bootstrap_total",
+            "Follower bootstrap/repair cycles by mode (image = full "
+            "checkpoint-image install; delta = re-install because the "
+            "chain position fell below the owner's compaction floor or "
+            "divergence was detected; tail = WAL catch-up only)",
+            ("mode",),
+        )
+        self.divergence_checks = r.counter(
+            "antidote_divergence_checks_total",
+            "Follower-vs-owner per-shard digest comparisons (ok | "
+            "skipped = applied clocks unequal, nothing comparable | "
+            "mismatch = divergence detected, follower re-bootstraps)",
+            ("result",),
+        )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)
         net_metrics().attach(r)

@@ -51,6 +51,42 @@ class ReadOnlyError(Exception):
         self.reason = reason
 
 
+class NotOwnerError(Exception):
+    """This node is a follower read replica: writes and interactive
+    transactions belong to the owner.  ``redirect`` is the owner's
+    client endpoint ``[host, port]`` (None when unknown) — the wire
+    reply carries it so a session client can re-route without operator
+    help (the follower-tier twin of the busy reply's retry hint)."""
+
+    def __init__(self, redirect=None):
+        where = f" at {redirect[0]}:{redirect[1]}" if redirect else ""
+        super().__init__(
+            f"this node is a follower read replica; route writes and "
+            f"interactive transactions to the owner{where}"
+        )
+        self.redirect = list(redirect) if redirect else None
+
+
+class ReplicaLagging(Exception):
+    """A follower's applied clock is still behind the session token
+    after its bounded park window (or the follower is mid-bootstrap /
+    mid-heal): the read was NOT served — serving it would violate the
+    session's read-your-writes / monotonic-reads guarantees.  Carries
+    the same retry-hint machinery as :class:`BusyError` plus the owner
+    redirect, so clients either wait out the hint or fail over."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50, redirect=None):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+        self.redirect = list(redirect) if redirect else None
+
+
+class ReplicaDown(ConnectionError):
+    """Every endpoint of a session (followers and owner alike) refused
+    or dropped the request — the typed terminal error of the session
+    client's failover loop."""
+
+
 def deadline_from_ms(deadline_ms, default_ms=None) -> Optional[float]:
     """Absolute monotonic deadline from a client-supplied relative ms
     budget (``None`` falls back to the configured default, which may
@@ -138,4 +174,5 @@ class AdmissionGate:
 
 
 __all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
+           "NotOwnerError", "ReplicaLagging", "ReplicaDown",
            "AdmissionGate", "deadline_from_ms", "check_deadline"]

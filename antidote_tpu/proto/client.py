@@ -18,6 +18,7 @@ from antidote_tpu.proto.codec import (
     decode,
     decode_value,
     encode_with,
+    merge_clock,
     read_frame_buffered,
 )
 
@@ -47,6 +48,28 @@ class RemoteDeadline(RemoteError):
 class RemoteReadOnly(RemoteError):
     """The node is in degraded read-only mode (WAL appends failing);
     writes are rejected, reads keep serving."""
+
+
+class RemoteNotOwner(RemoteError):
+    """The node is a follower read replica; writes and interactive
+    transactions must go to the owner.  ``redirect`` is the owner's
+    ``[host, port]`` when the follower knows it."""
+
+    def __init__(self, msg: str, redirect=None):
+        super().__init__(msg)
+        self.redirect = redirect
+
+
+class RemoteLagging(RemoteError):
+    """A follower's applied clock was still behind the session token
+    after its park window (or it is mid-bootstrap/heal): the read was
+    NOT served.  Retry after ``retry_after_ms`` or fail over —
+    ``redirect`` names the owner."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50, redirect=None):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+        self.redirect = redirect
 
 
 class ClientTxn:
@@ -90,8 +113,21 @@ class AntidoteClient:
     # ------------------------------------------------------------------
     def _call(self, code: MessageCode, body: Any):
         with self._lock:
-            self._sock.sendall(encode_with(self._packer, code, body))
-            resp_code, resp = decode(read_frame_buffered(self._rfile))
+            # tag transport failures with whether the request LEFT the
+            # socket: a send-phase failure is always safe to retry, a
+            # reply-phase one means the server may have executed it
+            # (the at-most-once discipline TcpFabric._rpc documents) —
+            # SessionClient keys its write-retry decision on this
+            try:
+                self._sock.sendall(encode_with(self._packer, code, body))
+            except (ConnectionError, OSError) as e:
+                e.request_sent = False
+                raise
+            try:
+                resp_code, resp = decode(read_frame_buffered(self._rfile))
+            except (ConnectionError, OSError) as e:
+                e.request_sent = True
+                raise
         if resp_code == MessageCode.ERROR_RESP:
             err = resp.get("error")
             if err == "aborted":
@@ -103,6 +139,13 @@ class AntidoteClient:
                 raise RemoteDeadline(resp.get("detail", ""))
             if err == "read_only":
                 raise RemoteReadOnly(resp.get("detail", ""))
+            if err == "not_owner":
+                raise RemoteNotOwner(resp.get("detail", ""),
+                                     redirect=resp.get("redirect"))
+            if err == "lagging":
+                raise RemoteLagging(resp.get("detail", ""),
+                                    int(resp.get("retry_after_ms", 50)),
+                                    redirect=resp.get("redirect"))
             raise RemoteError(f"{err}: {resp.get('detail')}")
         return resp
 
@@ -169,9 +212,164 @@ class AntidoteClient:
         Blocks for the image stream — admin use, not a data-path call."""
         return self._call(MessageCode.CHECKPOINT_NOW, {})["checkpoint"]
 
+    def replica_admin(self, op: str = "status", name: Optional[str] = None,
+                      addr=None) -> dict:
+        """Follower-replica registry op against an owner (console
+        `replica add/remove/status`); `status` also works against a
+        follower (its self view)."""
+        body: dict = {"op": op}
+        if name is not None:
+            body["name"] = name
+        if addr is not None:
+            body["addr"] = list(addr)
+        return self._call(MessageCode.REPLICA_ADMIN, body)["replicas"]
+
     def close(self) -> None:
         try:
             self._rfile.close()
         except OSError:
             pass
         self._sock.close()
+
+
+class SessionClient:
+    """Causal session over an owner + follower fleet (ISSUE 9).
+
+    Carries a compact VC session token: every commit clock and read
+    snapshot the session observes folds into the token
+    (:func:`~antidote_tpu.proto.codec.merge_clock`), and the token rides
+    as the causal clock of every request — so **read-your-writes** and
+    **monotonic reads** hold no matter which replica serves, across
+    arbitrary follower kills.
+
+    Routing: writes always go to the owner; reads stick to one follower
+    and fail over — to the next follower, and finally to the owner — on
+    a connection death or a typed ``lagging`` redirect (the follower's
+    applied clock hadn't caught the token inside its park window).  When
+    every endpoint fails, the typed
+    :class:`~antidote_tpu.overload.ReplicaDown` surfaces.
+    """
+
+    def __init__(self, owner, followers=(), timeout: float = 30.0):
+        self.owner = (owner[0], int(owner[1]))
+        self.followers = [(h, int(p)) for h, p in followers]
+        self.timeout = timeout
+        #: the session token (None until the first clock is observed)
+        self.token: Optional[List[int]] = None
+        self._conns: dict = {}
+        self._ridx = 0
+        #: session observability: typed lagging/not_owner redirects
+        #: honored, and endpoint failovers on connection death
+        self.redirects = 0
+        self.failovers = 0
+
+    # -- connections -----------------------------------------------------
+    def _conn(self, addr) -> AntidoteClient:
+        c = self._conns.get(addr)
+        if c is None:
+            try:
+                c = AntidoteClient(addr[0], addr[1],
+                                   timeout=self.timeout)
+            except (ConnectionError, OSError) as e:
+                # a DIAL failure never carried a request: tag it so the
+                # at-most-once write logic knows a retry is safe
+                e.request_sent = False
+                raise
+            self._conns[addr] = c
+        return c
+
+    def _drop(self, addr) -> None:
+        c = self._conns.pop(addr, None)
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def observe(self, clock) -> None:
+        """Fold an observed clock into the session token."""
+        self.token = merge_clock(self.token, clock)
+
+    # -- session ops -----------------------------------------------------
+    def update_objects(self, updates: Sequence[Tuple]) -> List[int]:
+        """Session write: always the owner; the commit clock folds into
+        the token so any replica serving a later read must cover it.
+        AT-MOST-ONCE: only a SEND-phase transport failure (the request
+        never left — e.g. a cached connection gone stale across an
+        owner restart) is redialed; a connection dying while awaiting
+        the reply surfaces typed, because the owner may have executed
+        the (non-idempotent) write and a blind resend would apply it
+        twice — the same discipline the inter-DC query channel keeps."""
+        from antidote_tpu.overload import ReplicaDown
+
+        last: Optional[BaseException] = None
+        for _attempt in range(2):
+            try:
+                vc = self._conn(self.owner).update_objects(
+                    updates, clock=self.token)
+                self.observe(vc)
+                return vc
+            except RemoteNotOwner as e:
+                # the "owner" endpoint is itself a follower (operator
+                # misconfiguration) but told us where to go
+                if not e.redirect:
+                    raise
+                self.redirects += 1
+                self.owner = (e.redirect[0], int(e.redirect[1]))
+                last = e
+            except (ConnectionError, OSError) as ex:
+                self._drop(self.owner)
+                self.failovers += 1
+                if getattr(ex, "request_sent", True):
+                    raise ConnectionError(
+                        f"session write: connection to owner "
+                        f"{self.owner} died awaiting the reply — the "
+                        "write may have executed; not resending"
+                    ) from ex
+                last = ex
+        raise ReplicaDown(
+            f"session write: owner {self.owner} unreachable"
+        ) from last
+
+    def read_objects(self, objects: Sequence[Tuple[Any, str, str]]):
+        """Session read: current follower first, then the remaining
+        followers, then the owner.  The reply's snapshot clock folds
+        into the token (monotonic reads)."""
+        from antidote_tpu.overload import ReplicaDown
+
+        n = len(self.followers)
+        order = [self.followers[(self._ridx + i) % n] for i in range(n)] \
+            if n else []
+        order.append(self.owner)
+        last: Optional[BaseException] = None
+        for i, addr in enumerate(order):
+            try:
+                vals, vc = self._conn(addr).read_objects(
+                    objects, clock=self.token)
+            except RemoteLagging as e:
+                self.redirects += 1
+                last = e
+                if n:
+                    self._ridx = (self._ridx + 1) % n
+                continue
+            except RemoteNotOwner as e:
+                self.redirects += 1
+                last = e
+                continue
+            except (ConnectionError, OSError) as ex:
+                self._drop(addr)
+                self.failovers += 1
+                last = ex
+                if n and i < n:
+                    self._ridx = (self._ridx + 1) % n
+                continue
+            self.observe(vc)
+            return vals, vc
+        raise ReplicaDown(
+            "session read: every endpoint (followers and owner) "
+            "refused or dropped the request"
+        ) from last
+
+    def close(self) -> None:
+        for addr in list(self._conns):
+            self._drop(addr)

@@ -32,6 +32,8 @@ class MessageCode(enum.IntEnum):
     CREATE_DC = 10
     NODE_STATUS = 11  # console/ops extension (no reference pb equivalent)
     CHECKPOINT_NOW = 12  # ops extension: synchronous checkpoint cycle
+    REPLICA_ADMIN = 13  # ops extension: follower-replica registry
+    # (add/remove/status against the owner's replica plane)
     # responses
     OPERATION_RESP = 64
     START_TRANSACTION_RESP = 65
@@ -68,6 +70,26 @@ def decode_value(v: Any) -> Any:
     if isinstance(v, list):
         return [decode_value(x) for x in v]
     return v
+
+
+def merge_clock(token, clock):
+    """Entry-wise max of two session clocks (either may be None) — the
+    SESSION TOKEN update rule: a client folds every commit clock and
+    read snapshot it observes into its token, and sends the token as the
+    causal ``clock`` of later requests, so read-your-writes and
+    monotonic reads hold across any replica it fails over to.  Lives in
+    the codec because the token IS the wire clock — one place owns its
+    shape (a plain list of per-DC ints)."""
+    if token is None:
+        return None if clock is None else [int(x) for x in clock]
+    if clock is None:
+        return [int(x) for x in token]
+    a, b = [int(x) for x in token], [int(x) for x in clock]
+    if len(b) > len(a):
+        a += [0] * (len(b) - len(a))
+    if len(a) > len(b):
+        b += [0] * (len(a) - len(b))
+    return [max(x, y) for x, y in zip(a, b)]
 
 
 def encode(code: MessageCode, body: Any) -> bytes:

@@ -30,7 +30,9 @@ from antidote_tpu.overload import (
     AdmissionGate,
     BusyError,
     DeadlineExceeded,
+    NotOwnerError,
     ReadOnlyError,
+    ReplicaLagging,
     check_deadline,
     deadline_from_ms,
 )
@@ -133,10 +135,29 @@ class ProtocolServer:
                  default_deadline_ms: Optional[float] = None,
                  epoch_tick_ms: float = 100.0,
                  snapshot_cache_size: Optional[int] = None,
-                 group_commit_window_us: float = 0.0):
+                 group_commit_window_us: float = 0.0,
+                 follower=None):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
+        #: FollowerReplica when this server fronts a read replica
+        #: (ISSUE 9): writes/txns answer typed not_owner redirects, and
+        #: session reads pass the follower's applied-clock gate (park
+        #: briefly, then typed lagging redirect) before dispatch
+        self.follower = follower
+        if follower is not None and interdc is None:
+            self.interdc = follower
+        if follower is not None and not batch_static:
+            # the inline (batch_static=False) read path calls
+            # node.read_objects under only the dispatch lock, but a
+            # follower's pump thread mutates the live head buffers via
+            # apply_effects — the commit-lock read discipline lives in
+            # the batch workers, so the combination would race (the
+            # "buffer donated" crash class); refuse it loudly
+            raise ValueError(
+                "a follower server requires batch_static=True (the "
+                "inline read path bypasses the replica's commit-lock "
+                "read discipline)")
         self._lock = threading.Lock()
         self._txns: Dict[int, Transaction] = {}
         #: metric sink for the overload planes: the node's own registry
@@ -377,10 +398,26 @@ class ProtocolServer:
                 # from the native msgpack codes, so existing
                 # antidotec_pb clients connect to the same port
                 if frame and frame[0] in apb.APB_REQUEST_CODES:
-                    resp_body = apb.handle_request(
-                        server_self, frame[0], frame[1:], conn_txns,
-                        lock=server_self._lock,
-                    )
+                    if server_self.follower is not None:
+                        # the follower tier is native-dialect only: the
+                        # apb handlers dispatch straight into
+                        # update/txn paths, bypassing both the
+                        # not_owner write refusal (an ACKED local write
+                        # on a follower is guaranteed divergence that
+                        # the digest heal would later silently DISCARD)
+                        # and the session read gate — refuse the whole
+                        # dialect with the owner's address in the text
+                        server_self.metrics.session_redirects.inc(
+                            kind="not_owner")
+                        e = NotOwnerError(
+                            server_self.follower.owner_client_addr)
+                        resp_body = apb.overload_error(
+                            "not_owner", str(e), 0)
+                    else:
+                        resp_body = apb.handle_request(
+                            server_self, frame[0], frame[1:], conn_txns,
+                            lock=server_self._lock,
+                        )
                     try:
                         write_frame_body(self.request, resp_body)
                     except (ConnectionError, OSError):
@@ -410,6 +447,20 @@ class ProtocolServer:
                 except DeadlineExceeded as e:
                     resp_code, resp = MessageCode.ERROR_RESP, {
                         "error": "deadline", "detail": str(e)
+                    }
+                except ReplicaLagging as e:
+                    # follower session gate: the read was NOT served —
+                    # the client retries after the hint or fails over
+                    # (the redirect names the owner)
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "lagging", "detail": str(e),
+                        "retry_after_ms": int(e.retry_after_ms),
+                        "redirect": e.redirect,
+                    }
+                except NotOwnerError as e:
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "not_owner", "detail": str(e),
+                        "redirect": e.redirect,
                     }
                 except ReadOnlyError as e:
                     resp_code, resp = MessageCode.ERROR_RESP, {
@@ -907,7 +958,20 @@ class ProtocolServer:
         # requests whose causal clock is already covered locally merge
         # into ONE snapshot read; a clock AHEAD of local replication (or
         # bogus) must WAIT inside start_transaction — running it solo
-        # keeps one slow client from head-of-line-blocking the batch
+        # keeps one slow client from head-of-line-blocking the batch.
+        # FOLLOWER MODE: locked-path reads gather from the LIVE head
+        # buffers, which the replica's pump thread mutates via
+        # apply_effects (a read-modify-REASSIGN with buffer donation) —
+        # on an owner the locked worker itself serializes reads against
+        # commits, but a follower's applies arrive on another thread, so
+        # the read must hold the same commit lock the ingress drain
+        # holds (the geo-peer read discipline).  The epoch plane stays
+        # lock-free either way (frozen buffers + the pin protocol).
+        import contextlib
+
+        read_lock = (self.node.txm.commit_lock
+                     if self.follower is not None
+                     else contextlib.nullcontext())
         covered = self._covered_vc()
         merged, solo = [], []
         for w in works:
@@ -928,7 +992,8 @@ class ProtocolServer:
                 objs.extend(w.objects)
                 offs.append(len(objs))
             try:
-                vals, vc = self.node.read_objects(objs, clock=clock)
+                with read_lock:
+                    vals, vc = self.node.read_objects(objs, clock=clock)
                 for i, w in enumerate(merged):
                     w.result = (vals[offs[i]:offs[i + 1]], vc)
                     w.event.set()
@@ -938,7 +1003,9 @@ class ProtocolServer:
             if w.event.is_set():
                 continue
             try:
-                w.result = self.node.read_objects(w.objects, clock=w.clock)
+                with read_lock:
+                    w.result = self.node.read_objects(w.objects,
+                                                      clock=w.clock)
             except Exception as e:
                 w.error = e
             w.event.set()
@@ -1071,13 +1138,36 @@ class ProtocolServer:
             body.get("deadline_ms") if isinstance(body, dict) else None,
             self.default_deadline_ms,
         )
+        # follower replicas (ISSUE 9) are read-only: writes and
+        # interactive transactions answer a typed not_owner redirect
+        # naming the owner's endpoint, and session reads pass the
+        # follower's applied-clock gate before any dispatch (park
+        # briefly, then a typed lagging redirect — never a stale read
+        # against a session token)
+        fol = self.follower
+        if fol is not None and code in (
+                MessageCode.STATIC_UPDATE_OBJECTS,
+                MessageCode.START_TRANSACTION,
+                MessageCode.UPDATE_OBJECTS,
+                MessageCode.COMMIT_TRANSACTION,
+                # DC-mesh mutations too: CONNECT_TO_DCS would subscribe
+                # the FOLLOWER to a peer DC's stream — it would then
+                # apply foreign-origin txns the owner never replicated,
+                # i.e. guaranteed divergence + an endless heal loop
+                MessageCode.CONNECT_TO_DCS,
+                MessageCode.CREATE_DC):
+            self.metrics.session_redirects.inc(kind="not_owner")
+            raise NotOwnerError(fol.owner_client_addr)
         # static ops route through the gate helpers OUTSIDE the lock (the
         # gate's dispatcher takes it; with batching off they lock inline)
         # — the ONLY static dispatch path, so it cannot drift from a
         # duplicate
         if code == MessageCode.STATIC_READ_OBJECTS:
+            objs = _decode_objects(body["objects"])
+            if fol is not None:
+                fol.gate_read(objs, _vc(body.get("clock")), deadline)
             out = self.static_read(
-                _decode_objects(body["objects"]), body.get("clock"),
+                objs, body.get("clock"),
                 deadline=deadline, wants_bytes=True,
             )
             if isinstance(out, RawReply):
@@ -1129,6 +1219,17 @@ class ProtocolServer:
                 self._txns.pop(txid, None)
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
+            }
+        if code == MessageCode.REPLICA_ADMIN:
+            # replica registry op (console replica add/remove/status),
+            # OUTSIDE the dispatch lock: pure registry bookkeeping on
+            # the replica plane, never a data-path call
+            if self.interdc is None or not hasattr(self.interdc,
+                                                   "replica_admin"):
+                raise RuntimeError("no replica plane attached (start "
+                                   "with --interdc or --follower-of)")
+            return MessageCode.OPERATION_RESP, {
+                "replicas": self.interdc.replica_admin(body or {})
             }
         if code == MessageCode.CHECKPOINT_NOW:
             # admin op, OUTSIDE the dispatch lock: the checkpointer has
@@ -1215,6 +1316,12 @@ class ProtocolServer:
                 "batch_gate_max": self._static_q.maxsize,
             })
             status["pipeline"] = self._pipeline_status()
+            if self.interdc is not None and hasattr(self.interdc,
+                                                    "replica_status"):
+                # follower liveness (owner: every follower with its
+                # typed ok/lagging/down state; follower: its own
+                # state/bootstrap/divergence view)
+                status["replicas"] = self.interdc.replica_status()
             return MessageCode.OPERATION_RESP, {"status": status}
         raise ValueError(f"unhandled message code {code!r}")
 

@@ -83,6 +83,69 @@ def stable_min_of(clock_rows: np.ndarray, use_pallas: bool = False) -> np.ndarra
     return clock_rows.min(axis=0)
 
 
+def _canon(v: Any) -> Any:
+    """Canonical msgpack-able form of a client-visible CRDT value for
+    digesting: dicts become sorted pair lists (msgpack maps can't carry
+    tuple keys and dict order is insertion order), numpy scalars become
+    ints — so two replicas holding the same logical value always hash
+    identically."""
+    if isinstance(v, dict):
+        pairs = [[_canon(k), _canon(x)] for k, x in v.items()]
+        import msgpack as _mp
+
+        pairs.sort(key=lambda p: _mp.packb(p[0], use_bin_type=True,
+                                           default=repr))
+        return ["\x00map", pairs]
+    if isinstance(v, (list, tuple)):
+        return [_canon(x) for x in v]
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    return v
+
+
+def shard_digest(store: "KVStore", shard: int) -> str:
+    """Content digest of one shard's materialized state at its CURRENT
+    applied clock — the divergence-detection primitive of the follower
+    read tier (ISSUE 9).
+
+    Hashes every directory entry of the shard (sorted canonically) with
+    its decoded client-visible value at ``applied_vc[shard]``, plus the
+    clock itself.  Values (not raw table rows) make the digest
+    independent of slot-tier promotion timing and row-allocation order,
+    which legitimately differ between a replica applying effects in
+    commit batches and one applying them in drain batches.  Two
+    replicas whose ``applied_vc[shard]`` are EQUAL have applied the
+    same per-chain prefixes (chain timestamps are monotone and a lane
+    only advances past ts once the op carrying ts applied), so equal
+    clocks ⇒ the digests MUST match; a mismatch is silent corruption.
+
+    Caller must hold the commit lock (the clock and the heads must be
+    one cut).  Cost: one device gather per touched table + one decode
+    per key — a periodic-check price, not a serving-path one.
+    """
+    import hashlib
+
+    import msgpack as _mp
+
+    objs = []
+    for (key, bucket), (tname, s, _row) in store.directory.items():
+        if s == shard:
+            objs.append((key, split_tier(tname)[0], bucket))
+    objs.sort(key=lambda o: _mp.packb([o[0], o[2], o[1]],
+                                      use_bin_type=True, default=repr))
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(store.applied_vc[shard],
+                                  dtype=np.int64).tobytes())
+    if objs:
+        vals = store.read_values(objs, store.applied_vc[shard])
+        for (key, tname, bucket), v in zip(objs, vals):
+            h.update(_mp.packb([_canon(key), bucket, tname, _canon(v)],
+                               use_bin_type=True, default=repr))
+    return h.hexdigest()
+
+
 def freeze_key(key: Any) -> Any:
     """Normalize a key after wire/log deserialization: msgpack returns
     tuples as lists, but directory keys must be hashable."""
@@ -206,16 +269,22 @@ class ServingEpoch:
     """
 
     __slots__ = ("id", "prev_id", "vc", "mut_epoch", "tables", "used_rows",
-                 "touched", "promoted", "pins", "born")
+                 "touched", "promoted", "pins", "born", "applied")
 
     def __init__(self, id_, prev_id, vc, mut_epoch, tables, used_rows,
-                 touched):
+                 touched, applied=None):
         self.id = id_
         self.prev_id = prev_id
         self.vc = vc
         self.mut_epoch = mut_epoch
         self.tables = tables
         self.used_rows = used_rows
+        #: per-shard applied-clock cut at capture (i32[n_shards, D]) —
+        #: the follower session gate's evidence that this epoch's frozen
+        #: buffers actually contain a token's per-shard coverage (the
+        #: cross-shard-max ``vc`` alone can claim lanes a lagging
+        #: shard's buffer lacks, via ping-skew)
+        self.applied = applied
         #: tname -> frozenset of (shard, row) re-frozen at THIS publish
         #: (None = full copy / unknown) — drives snapshot-cache
         #: revalidation across epoch advances for untouched keys
@@ -625,6 +694,13 @@ class KVStore:
         """
         cur = self.serving_epoch
         if cur is not None and cur.mut_epoch == self.mutation_epoch:
+            # safe-time PINGS advance the applied clocks without any
+            # data apply (mutation epoch unchanged ⇒ the frozen buffers
+            # still hold every applied op): refresh the epoch's
+            # applied-clock cut so a follower's session gate — which
+            # trusts the cut, not the cross-shard-max vc — doesn't spin
+            # on a stale capture after the last write of a burst
+            cur.applied = self.applied_vc.copy()
             return "noop"
         m = self.metrics
         with self._epoch_lock:
@@ -671,7 +747,7 @@ class KVStore:
         ep = ServingEpoch(
             self._serving_seq, cur.id if cur is not None else None,
             np.asarray(vc, np.int32), self.mutation_epoch, slots, used,
-            touched,
+            touched, applied=self.applied_vc.copy(),
         )
         with self._epoch_lock:
             old = self.serving_epoch

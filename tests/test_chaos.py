@@ -1086,6 +1086,169 @@ def test_sigkill_mid_checkpoint_and_mid_truncation_recover_exact(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# scenario 15: follower read tier under fire (ISSUE 9) — 1 owner + 2
+# followers with seeded drop/delay on their streams and a stretched
+# image-shipping window (ckpt.ship); SIGKILL one follower mid-catch-up;
+# the client session fails over with read-your-writes held; the killed
+# follower rejoins from checkpoint images and converges byte-identical
+# ---------------------------------------------------------------------------
+def test_follower_tier_sigkill_failover_and_rejoin(tmp_path):
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.proto.client import (AntidoteClient, RemoteLagging,
+                                           SessionClient)
+
+    env_owner = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # stretch the image-shipping window so follower bootstraps are
+        # genuinely mid-flight work (and chaos kills can land inside)
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 15, "rules": [
+            {"site": "ckpt.ship", "action": "delay", "arg": 0.05},
+        ]}),
+    )
+    env_follower = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # seeded drop/delay storm on the follower's subscription stream:
+        # chain gaps open constantly and heal through catch-up
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 15, "rules": [
+            {"site": "interdc.deliver", "action": "drop", "p": 0.08,
+             "times": 200},
+            {"site": "interdc.deliver", "action": "delay", "p": 0.08,
+             "times": 200},
+        ]}),
+    )
+
+    def spawn_owner():
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--shards", "2", "--max-dcs", "2",
+             "--log-dir", str(tmp_path / "owner"), "--interdc",
+             "--interdc-port", "0", "--checkpoint-interval-s", "0.5"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env_owner, text=True,
+        )
+
+    def spawn_follower(name, owner_info):
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--log-dir", str(tmp_path / name),
+             "--follower-of",
+             f"{owner_info['host']}:{owner_info['port']}",
+             "--replica-name", name, "--follower-park-ms", "200",
+             "--divergence-check-s", "0.5"],
+            stdout=subprocess.PIPE,
+            stderr=open(str(tmp_path / (name + ".log")), "a"),
+            env=env_follower, text=True,
+        )
+
+    owner = spawn_owner()
+    f1 = f2 = f1b = None
+    procs = [owner]
+    try:
+        oinfo = json.loads(owner.stdout.readline())
+        assert oinfo["ready"] is True
+        oc = AntidoteClient(oinfo["host"], oinfo["port"])
+        keys = [f"k{i}" for i in range(4)]
+        totals = {k: 0 for k in keys}
+        for r in range(5):
+            for k in keys:
+                oc.update_objects([(k, "counter_pn", "b",
+                                    ("increment", 1))])
+                totals[k] += 1
+        # wait for a published image so followers IMAGE-bootstrap (the
+        # shipping path is the thing under test)
+        deadline = time.monotonic() + 30
+        while (oc.node_status().get("checkpoint", {}).get("last_id")
+               or 0) < 1:
+            assert time.monotonic() < deadline, "no owner checkpoint"
+            time.sleep(0.1)
+        f1 = spawn_follower("f1", oinfo)
+        procs.append(f1)
+        i1 = json.loads(f1.stdout.readline())
+        f2 = spawn_follower("f2", oinfo)
+        procs.append(f2)
+        i2 = json.loads(f2.stdout.readline())
+        assert i1["ready"] and i2["ready"]
+        assert i1["bootstrap"] == "image" and i2["bootstrap"] == "image"
+
+        sc = SessionClient((oinfo["host"], oinfo["port"]),
+                           [(i1["host"], i1["port"]),
+                            (i2["host"], i2["port"])])
+        # phase 1: session writes + reads under the seeded storm —
+        # read-your-writes must hold on every single read
+        for r in range(8):
+            k = keys[r % len(keys)]
+            sc.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+            totals[k] += 1
+            vals, _ = sc.read_objects([(k, "counter_pn", "b")])
+            assert vals == [totals[k]], (k, vals, totals[k])
+        # phase 2: a write burst puts the followers mid-catch-up, then
+        # SIGKILL f1 — the session must keep its guarantees by failing
+        # over (f2 / owner), never by serving stale data
+        for k in keys:
+            for _ in range(5):
+                oc.update_objects([(k, "counter_pn", "b",
+                                    ("increment", 1))])
+                totals[k] += 1
+        f1.send_signal(signal.SIGKILL)
+        f1.wait(timeout=10)
+        for r in range(8):
+            k = keys[r % len(keys)]
+            sc.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+            totals[k] += 1
+            vals, _ = sc.read_objects([(k, "counter_pn", "b")])
+            assert vals == [totals[k]], (k, vals, totals[k])
+        assert sc.failovers + sc.redirects >= 1
+        # phase 3: rejoin f1 from its images (local checkpoint + the
+        # owner's shipped image/tail) and converge byte-identical
+        f1b = spawn_follower("f1", oinfo)
+        procs.append(f1b)
+        i1b = json.loads(f1b.stdout.readline())
+        assert i1b["ready"]
+        assert i1b["bootstrap"] in ("image", "delta", "tail")
+        fc = AntidoteClient(i1b["host"], i1b["port"])
+        objs = [(k, "counter_pn", "b") for k in keys]
+        token = sc.token
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                vals, _ = fc.read_objects(objs, clock=token)
+            except RemoteLagging:
+                vals = None
+            if vals == [totals[k] for k in keys]:
+                st = fc.node_status()["replicas"]
+                # the periodic digest sweep compared clean against the
+                # owner at least once, and never found a mismatch
+                if (st["state"] == "serving"
+                        and st["divergence"].get("ok", 0) >= 1
+                        and st["divergence"].get("mismatch", 0) == 0):
+                    break
+            assert time.monotonic() < deadline, (
+                f"rejoined follower never converged: {vals} != {totals}")
+            time.sleep(0.2)
+        # owner registry: f1 and f2 both live again
+        st = oc.replica_admin("status")
+        assert st["followers"]["f1"]["state"] == "ok"
+        assert st["followers"]["f2"]["state"] == "ok"
+        fc.close()
+        sc.close()
+        oc.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+# ---------------------------------------------------------------------------
 # long soak (excluded from tier-1 via -m 'not slow'; run with `make chaos`)
 # ---------------------------------------------------------------------------
 @pytest.mark.slow

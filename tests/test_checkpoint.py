@@ -400,7 +400,10 @@ def test_compacted_handoff_carries_chain_floor(dcfg, tmp_path):
     assert pkg["chain_floor"] is not None and sum(pkg["chain_floor"]) > 0
     dst = AntidoteNode(dcfg, log_dir=str(tmp_path / "dst"))
     dst.receive_handoff(pkg)
-    assert dst.store.log.chain_base(shard, 0) == \
+    # the import-then-checkpoint barrier (ISSUE 9) seals the import with
+    # a local image, which may advance the importer's floor PAST the
+    # source's (it covers the ride-along tail too) — never below it
+    assert dst.store.log.chain_base(shard, 0) >= \
         src.store.log.chain_base(shard, 0)
     dst_rep = DCReplica(dst, LoopbackHub(), "dst")
     dst_rep.restore_from_log()
@@ -410,6 +413,89 @@ def test_compacted_handoff_carries_chain_floor(dcfg, tmp_path):
     vals, _ = dst.read_objects([("hk", "counter_pn", "b")], clock=clock)
     assert vals == [7]
     src.store.log.close(), dst.store.log.close()
+
+
+def test_compacted_import_checkpoint_barrier_survives_sigkill(dcfg,
+                                                              tmp_path):
+    """ISSUE 9 satellite, closing the PR-7 handoff residual: importing a
+    shard FROM a checkpoint-compacted source is now a SYNCHRONOUS
+    import-then-checkpoint barrier — ``receive_handoff`` does not return
+    until a LOCAL image covers the moved rows.  Pinned with a real
+    SIGKILL inside the old bug's window: the importer is killed -9
+    immediately after the import returns (before any graceful shutdown),
+    and recovery must still serve the moved rows' FULL pre-checkpoint
+    history (the nudge-only behavior recovered a silently wrong
+    tail-only value — the ride-along log holds just the tail)."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from antidote_tpu.store import handoff
+
+    src = AntidoteNode(dcfg, log_dir=str(tmp_path / "src"))
+    for _ in range(6):
+        src.update_objects([("hk", "counter_pn", "b", ("increment", 1))])
+    src.checkpoint_now()
+    src.update_objects([("hk", "counter_pn", "b", ("increment", 1))])
+    shard = src.store.directory[("hk", "b")][1]
+    pkg = handoff.export_shard(src.store, shard)
+    assert pkg["compacted"] is True
+    assert len(pkg["log"]) == 1  # the ride-along log is tail-only
+    pkg_path = str(tmp_path / "pkg.bin")
+    with open(pkg_path, "wb") as f:
+        f.write(handoff.pack(pkg))
+    dst_dir = str(tmp_path / "dst")
+    import dataclasses
+
+    child_src = (
+        "import json, sys, time\n"
+        "from antidote_tpu.api import AntidoteNode\n"
+        "from antidote_tpu.config import AntidoteConfig\n"
+        "from antidote_tpu.store import handoff\n"
+        "cfgd = json.loads(sys.argv[1])\n"
+        "cfgd['batch_buckets'] = tuple(cfgd['batch_buckets'])\n"
+        "cfg = AntidoteConfig(**cfgd)\n"
+        "pkg = handoff.unpack(open(sys.argv[2], 'rb').read())\n"
+        "node = AntidoteNode(cfg, log_dir=sys.argv[3])\n"
+        "node.receive_handoff(pkg)\n"
+        "print('IMPORTED', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src,
+         json.dumps(dataclasses.asdict(dcfg)), pkg_path, dst_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True,
+    )
+    try:
+        t0 = time.monotonic()
+        line = proc.stdout.readline().strip()
+        assert line == "IMPORTED", (line, proc.poll())
+        assert time.monotonic() - t0 < 120
+        # the window the nudge left open: kill -9 right after the
+        # import acknowledged
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # the barrier's artifact: a local image was published BEFORE the
+    # import returned
+    assert ckpt.list_checkpoints(ckpt.checkpoint_root(dst_dir))
+    # two independent recoveries serve the moved rows' full history
+    for _ in range(2):
+        d2 = AntidoteNode(dcfg, log_dir=dst_dir, recover=True)
+        vals, _ = d2.read_objects([("hk", "counter_pn", "b")])
+        assert vals == [7], vals
+        d2.store.log.close()
+    src.store.log.close()
 
 
 def test_checkpoint_now_over_the_wire(dcfg, tmp_path):

@@ -53,16 +53,23 @@ def test_reassembly_and_batching(pump):
 
 def test_eof_tail_delivered(pump):
     """Frames sent immediately before the peer closes must still be
-    delivered (the stream's last commits ride exactly there)."""
+    delivered (the stream's last commits ride exactly there) — and the
+    close itself must surface as the kind-0 drop SENTINEL (PR 2's
+    resubscribe hook), strictly AFTER the tail frames: a sentinel
+    overtaking data would make Python resubscribe while the last
+    commits die in the buffer."""
     a, b = socket.socketpair()
     pump.add(b.detach(), tag=9)
     a.sendall(_frame(2, b"final-1") + _frame(2, b"final-2"))
     a.close()  # EOF races the reads
     got = []
     deadline = time.time() + 5
-    while len(got) < 2 and time.time() < deadline:
+    while time.time() < deadline and not any(k == 0 for _, k, _ in got):
         got.extend(pump.take_batch(200))
-    assert [p for _, _, p in got] == [b"final-1", b"final-2"]
+    assert [p for _, k, p in got if k != 0] == [b"final-1", b"final-2"]
+    # exactly one drop sentinel, carrying the stream's tag, at the end
+    assert [(t, k, p) for t, k, p in got if k == 0] == [(9, 0, b"")]
+    assert got[-1][1] == 0
 
 
 def test_large_frame_grows_buffer(pump):

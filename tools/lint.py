@@ -23,7 +23,11 @@ Checks (each one has caught a real bug class in this codebase's history):
   * file deletion (``os.remove``/``os.unlink``/``rmtree``) outside
     ``antidote_tpu/log/`` without a ``# reclaim-ok:`` note — WAL and
     checkpoint files are reclaimed only through the guarded floor APIs
-    (ISSUE 8).
+    (ISSUE 8);
+  * serving-epoch publishes in ``antidote_tpu/interdc/`` (the
+    follower/replica plane) that bypass the applied-VC stamp without a
+    ``# vc-stamped:`` note — a follower publishing an epoch ahead of
+    its applied clock silently violates causality (ISSUE 9).
 
 Usage: python tools/lint.py [paths...]   (default: antidote_tpu tests
 bench.py bench_suite.py bench_wire.py tpu_smoke.py __graft_entry__.py)
@@ -119,6 +123,7 @@ def check_file(path: str):
     _check_serving_syncs(path, lines, problems)
     _check_fsync_policy(path, lines, problems)
     _check_reclaim_policy(path, lines, problems)
+    _check_epoch_stamp(path, lines, problems)
     return problems
 
 
@@ -285,6 +290,47 @@ def _check_reclaim_policy(path, lines, problems) -> None:
                     "through the guarded floor APIs (LogManager."
                     "reclaim_below / truncate_shard), or justify with "
                     "'# reclaim-ok: <reason>'"
+                )
+
+
+#: the replica plane (interdc/ — follower + peer replicas): a serving
+#: epoch published there claims "every op ≤ this VC has applied", and a
+#: follower stamping one AHEAD of its applied clock (e.g. from the
+#: owner's commit counter) is a silent causal-violation machine —
+#: session reads would be told their token is covered by data that
+#: never arrived.  Publishes in this plane must ride
+#: FollowerReplica.publish_applied_epoch_locked (which slaves the
+#: counter to the applied clock first) or carry a written
+#: ``# vc-stamped: <why the VC is the applied clock>`` justification.
+_EPOCH_STAMP_PLANE = os.path.join("antidote_tpu", "interdc")
+_EPOCH_STAMP_TOKENS = ("publish_serving_epoch(",
+                       "_publish_serving_epoch_locked(")
+
+
+def _check_epoch_stamp(path, lines, problems) -> None:
+    """In interdc/ (follower/replica paths), reject serving-epoch
+    publishes that bypass the applied-VC stamp: flag the publish calls
+    unless a ``# vc-stamped:`` annotation on the line or within the
+    three preceding lines states why the published VC is exactly the
+    applied clock."""
+    norm = os.path.normpath(path)
+    if _EPOCH_STAMP_PLANE not in norm:
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("vc-stamped:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        for tok in _EPOCH_STAMP_TOKENS:
+            if tok in code and not annotated(i) and "vc-stamped:" not in ln:
+                problems.append(
+                    f"{path}:{i}: serving-epoch publish '{tok}' in the "
+                    "interdc/follower plane without the applied-VC "
+                    "stamp — route through FollowerReplica."
+                    "publish_applied_epoch_locked, or justify with "
+                    "'# vc-stamped: <reason>'"
                 )
 
 
