@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke mesh-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -59,6 +59,18 @@ restart-smoke:
 replica-smoke:
 	$(PY) -m pytest tests/test_follower.py -q
 	$(PY) bench_wire.py --follower-fanout --smoke --assert-bounds
+
+# mesh serving plane (ISSUE 10): the deterministic mesh suite on the
+# forced 8-device CPU mesh (read parity byte-identical with the
+# single-chip plane, per-shard incremental publish, pmin == host stable
+# time, donation under commits) plus a short scaling run — the gate is
+# STRUCTURAL only (parity clean, burst publish ∝ dirty rows, artifact
+# shape); the frozen BENCH_MESH_cpu.json curve is never a throughput
+# ratchet (2-core container — see its host_note)
+mesh-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -m pytest tests/test_mesh.py -q
+	$(PY) tools/bench_mesh.py --smoke --assert-bounds
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

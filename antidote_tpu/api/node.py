@@ -289,6 +289,10 @@ class AntidoteNode:
             },
             "durable": self.store.log is not None,
         }
+        if self.store.mesh is not None:
+            # mesh serving plane (ISSUE 10): device count, per-shard
+            # publish rows, stable-collective latency
+            out["mesh"] = self.store.mesh.status()
         # fabric/RPC resilience counters (process-wide; see NetMetrics):
         # operators watch these to see partitions heal and retries drain
         from antidote_tpu.obs.metrics import net_metrics

@@ -124,9 +124,27 @@ def cmd_serve(args) -> int:
         # file below its floor was reclaimed
         or has_checkpoints(args.log_dir)
     )
+    mesh_plane = None
+    if getattr(args, "mesh_devices", 0):
+        # mesh serving plane (ISSUE 10): shard the serving-epoch store
+        # over a device mesh.  Built BEFORE the node so recovery-created
+        # tables are placed at creation; attached after so the stable
+        # pmin collective and per-shard publishes route through it.
+        from antidote_tpu.parallel import MeshServingPlane
+
+        try:
+            mesh_plane = MeshServingPlane(cfg, args.mesh_devices)
+        except ValueError as e:
+            log(f"--mesh-devices {args.mesh_devices}: {e}")
+            return 2
     recover = args.recover or has_wal_data
     node = AntidoteNode(cfg, dc_id=args.dc_id, log_dir=args.log_dir,
-                        recover=recover)
+                        recover=recover,
+                        sharding=mesh_plane.sharding
+                        if mesh_plane is not None else None)
+    if mesh_plane is not None:
+        mesh_plane.metrics = node.metrics
+        mesh_plane.attach(node.store)
     if args.log_dir is not None and args.checkpoint_interval_s > 0:
         node.start_checkpointer(interval_s=args.checkpoint_interval_s,
                                 retain=args.checkpoint_retain)
@@ -238,9 +256,13 @@ def cmd_serve(args) -> int:
                       "name": follower.name})
         log(f"follower {follower.name} of {args.follower_of} serving "
             f"(bootstrap mode={mode})")
+    if mesh_plane is not None:
+        ready["mesh_devices"] = mesh_plane.n_devices
     log(f"antidote_tpu dc{args.dc_id} serving on "
         f"{server.host}:{server.port} (recovered={recover}, "
-        f"keys={len(node.store.directory)})")
+        f"keys={len(node.store.directory)}"
+        + (f", mesh={mesh_plane.n_devices}dev"
+           if mesh_plane is not None else "") + ")")
     print(json.dumps(ready), flush=True)
     try:
         while True:
@@ -564,6 +586,13 @@ def main(argv=None) -> int:
                     help="server-side deadline for requests that carry no "
                          "deadline_ms field; work that outlives it is "
                          "aborted at dequeue (default: no deadline)")
+    sv.add_argument("--mesh-devices", type=int, default=0,
+                    help="shard the serving-epoch store over this many "
+                         "devices (jax.sharding.Mesh; n_shards must be "
+                         "divisible by it; 0 = single-chip serving "
+                         "plane).  Stable time becomes a pmin "
+                         "collective and epoch publishes go per-shard "
+                         "incremental (ISSUE 10)")
     sv.add_argument("--epoch-tick-ms", type=float, default=100.0,
                     help="serving-epoch publication cadence for the "
                          "dedicated ticker (<= 0 disables the lock-split "

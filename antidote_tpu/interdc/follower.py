@@ -413,6 +413,13 @@ class FollowerReplica(DCReplica):
             })
             store = KVStore(cfg, sharding=old.sharding, log=logm)
             store.metrics = getattr(node, "metrics", None)
+            if old.mesh is not None:
+                # a mesh-placed follower stays mesh-placed across every
+                # reinstall/heal: re-attach the plane so the fresh
+                # store's stable time keeps routing through the pmin
+                # collective (and the plane stops pinning the discarded
+                # store's device arrays)
+                old.mesh.attach(store)
             # epoch ids continue: a reader-pinned epoch of the old store
             # (or a stale snapshot-cache stamp) must never collide with
             # a fresh id

@@ -181,6 +181,28 @@ def counter_fold(base_cnt, deltas, ops_vc, n_ops, base_vc, read_vc,
     return jnp.asarray(base_cnt, jnp.int64) + dcnt.astype(jnp.int64), applied
 
 
+def counter_fold_local(deltas, ops_vc, n_ops, base_vc, read_vc,
+                       block: int = 256, interpret: bool | None = None):
+    """Shard-LOCAL counter fold — the kernel entry for sharded-step /
+    shard_map bodies (ISSUE 10): operands are ONE shard's block
+    (``deltas`` i32[M, K], ``ops_vc`` i32[M, K, D], ``n_ops`` i32[M] —
+    the shard-local valid-prefix extents — ``base_vc``/``read_vc``
+    i32[M, D]), so the kernel grid never crosses the shard axis and the
+    fold stays device-local on a mesh.  Returns (delta-sum i32[M],
+    applied i32[M]); the caller adds the base counters and owns the
+    i32-delta overflow bound (typed_table gates on its host-tracked
+    ``max_abs_delta`` before dispatching here).  Trace-safe: no x64
+    toggling, no host-side bound check — callable from inside an outer
+    jit/shard_map trace (the kernels are dtype-pinned)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _counter_fold_call(
+        jnp.asarray(deltas, jnp.int32), jnp.asarray(ops_vc, jnp.int32),
+        jnp.asarray(n_ops, jnp.int32), jnp.asarray(base_vc, jnp.int32),
+        jnp.asarray(read_vc, jnp.int32), block, interpret,
+    )
+
+
 # ---------------------------------------------------------------------------
 # stable-snapshot min: entry-wise min over N clock rows
 # ---------------------------------------------------------------------------

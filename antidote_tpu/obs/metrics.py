@@ -449,6 +449,28 @@ class NodeMetrics:
             "antidote_serving_epoch_id",
             "Monotone id of the last published serving epoch",
         )
+        # mesh serving plane (ISSUE 10): device count, per-shard
+        # incremental publish rows, and the stable-time pmin collective
+        self.mesh_devices = r.gauge(
+            "antidote_mesh_devices",
+            "Devices in the serving mesh (0 / absent = single-chip "
+            "serving plane)",
+        )
+        self.mesh_publish = r.counter(
+            "antidote_mesh_publish_total",
+            "Rows re-frozen into each shard's device slice by serving-"
+            "epoch publications on the mesh plane — an incremental "
+            "publish advances only the dirty shards' labels; a full "
+            "copy advances every shard by its table rows",
+            ("shard",),
+        )
+        self.mesh_stable_seconds = r.histogram(
+            "antidote_mesh_stable_seconds",
+            "Stable-time pmin collective latency, launch to host "
+            "readback (s); launched only when a commit advanced an "
+            "applied clock (cached otherwise)",
+            buckets=stage_buckets,
+        )
         # write plane (ISSUE 6): cross-connection group commit, parallel
         # WAL group fsync, and the commutative-update cert bypass
         self.commit_merge_width = r.histogram(

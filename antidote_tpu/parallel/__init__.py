@@ -1,3 +1,15 @@
-from antidote_tpu.parallel.spmd import make_mesh, shard_axis_sharding, sharded_step_fn
+from antidote_tpu.parallel.mesh import MeshServingPlane
+from antidote_tpu.parallel.spmd import (
+    SHARD_AXIS,
+    make_mesh,
+    shard_axis_sharding,
+    sharded_step_fn,
+)
 
-__all__ = ["make_mesh", "shard_axis_sharding", "sharded_step_fn"]
+__all__ = [
+    "MeshServingPlane",
+    "SHARD_AXIS",
+    "make_mesh",
+    "shard_axis_sharding",
+    "sharded_step_fn",
+]

@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from antidote_tpu.compat import shard_map
-from antidote_tpu.store.typed_table import _shard_read_body
+from antidote_tpu.store.typed_table import _shard_base_select_body, _shard_read_body
 
 SHARD_AXIS = "shard"
 
@@ -54,8 +54,23 @@ def sharded_step_fn(ty, cfg, mesh: Mesh):
     Returns (new ops arrays, read state pytree [P, Mr, ...], applied [P, Mr],
     complete [P, Mr], new_applied_vc [P, D], stable_vc [P, D] — the pmin,
     identical on every shard row).
+
+    With ``cfg.use_pallas`` and a counter table, the ring fold inside
+    the step dispatches to the fused Pallas kernel with SHARD-LOCAL
+    extents (``pallas_kernels.counter_fold_local``): each shard's block
+    runs its own kernel grid inside the shard_map body, so the fold
+    stays device-local on a mesh (interpret mode off-TPU).  CALLER
+    CONTRACT: the kernel sums lane-0 deltas in i32, and a static step
+    fn cannot host-gate per batch — only enable ``use_pallas`` when
+    every |delta| ≤ INT32_MAX // ops_per_key (the bound typed_table
+    enforces dynamically via its host-tracked ``max_abs_delta`` before
+    choosing ITS pallas dispatch; here the check is yours).
     """
     read_body = _shard_read_body(ty, cfg)
+    pallas_counter = (
+        bool(getattr(cfg, "use_pallas", False)) and ty.name == "counter_pn"
+    )
+    select_body = _shard_base_select_body(ty, cfg) if pallas_counter else None
 
     def per_shard(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
                   app_rows, app_slots, app_a, app_b, app_vc, app_origin,
@@ -88,10 +103,26 @@ def sharded_step_fn(ty, cfg, mesh: Mesh):
         stable = lax.pmin(new_applied, SHARD_AXIS)
         # 4. batched materializer read
         rows_clip = jnp.minimum(read_rows, n - 1)
-        state, applied, complete = read_body(
-            snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
-            rows_clip, read_n_ops, read_vcs,
-        )
+        if pallas_counter:
+            # Pallas fold with shard-local extents, inside the sharded
+            # step: version-select the base on this shard's block, then
+            # one fused masked-sum kernel over the local ring slice —
+            # the kernel grid never crosses the shard axis
+            from antidote_tpu.materializer import pallas_kernels as pk
+
+            base_state, base_vc, complete = select_body(
+                snap, snap_vc, snap_seq, rows_clip, read_vcs
+            )
+            dcnt, applied = pk.counter_fold_local(
+                ops_a[rows_clip][..., 0].astype(jnp.int32),
+                ops_vc[rows_clip], read_n_ops, base_vc, read_vcs,
+            )
+            state = {"cnt": base_state["cnt"] + dcnt.astype(jnp.int64)}
+        else:
+            state, applied, complete = read_body(
+                snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
+                rows_clip, read_n_ops, read_vcs,
+            )
         ex = lambda t: jax.tree.map(lambda x: x[None], t)
         return (
             ex(ops_a), ex(ops_b), ex(ops_vc), ex(ops_origin),

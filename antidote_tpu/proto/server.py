@@ -219,6 +219,14 @@ class ProtocolServer:
                 txm.store.snapshot_cache_cap = int(snapshot_cache_size)
             if txm.store.metrics is None:
                 txm.store.metrics = self.metrics
+        #: mesh serving plane (ISSUE 10): the LAUNCH stage routes mesh
+        #: tables through per-shard [P, M'] gathers, which pad per
+        #: shard — scale the merge chunk so each DEVICE still sees a
+        #: full batch (chunk/P objects land on each device slice)
+        mesh = getattr(getattr(txm, "store", None), "mesh", None) \
+            if txm is not None else None
+        self._epoch_chunk = self.EPOCH_LAUNCH_CHUNK * (
+            mesh.n_devices if mesh is not None else 1)
         #: launched-but-unmaterialized epoch read batches between the
         #: dispatcher and the writeback worker.  BOUNDED: a lagging
         #: writeback stage backpressures the dispatcher (which then
@@ -833,13 +841,14 @@ class ProtocolServer:
     EPOCH_LAUNCH_CHUNK = 512
 
     def _chunk_epoch_works(self, works: List[_StaticWork]):
-        """Split eligible works into launch chunks of ≤ EPOCH_LAUNCH_CHUNK
-        total objects (a single oversized work still gets its own chunk —
-        the bucket ladder handles it)."""
+        """Split eligible works into launch chunks of ≤ the epoch chunk
+        size — EPOCH_LAUNCH_CHUNK, scaled by the mesh device count for
+        mesh-routed launches — total objects (a single oversized work
+        still gets its own chunk; the bucket ladder handles it)."""
         chunk: List[_StaticWork] = []
         n = 0
         for w in works:
-            if chunk and n + len(w.objects) > self.EPOCH_LAUNCH_CHUNK:
+            if chunk and n + len(w.objects) > self._epoch_chunk:
                 yield chunk
                 chunk, n = [], 0
             chunk.append(w)
@@ -1406,6 +1415,8 @@ class ProtocolServer:
         if txm is not None:
             out["snapshot_cache"]["size"] = len(txm.store.snapshot_cache)
             out["snapshot_cache"]["cap"] = txm.store.snapshot_cache_cap
+            if txm.store.mesh is not None:
+                out["mesh"] = txm.store.mesh.status()
         return out
 
     # ------------------------------------------------------------------

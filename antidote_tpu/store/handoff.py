@@ -107,9 +107,12 @@ def export_shard(store: KVStore, shard: int,
         sl["used"] = used
         sl["next_seq"] = int(t.next_seq)
         pkg["tables"][tname] = sl
-    for (key, bucket), (tname, s, row) in store.directory.items():
-        if s == shard:
-            pkg["directory"].append((key, bucket, tname, int(row)))
+    # per-shard directory index: exactly the shard's keys, not an
+    # O(total keys) filter (ISSUE 10 satellite)
+    for key, bucket in sorted(store.directory.shard_keys(shard),
+                              key=repr):
+        tname, _s, row = store.directory[(key, bucket)]
+        pkg["directory"].append((key, bucket, tname, int(row)))
     if with_log:
         pkg["log"] = list(store.log.replay_shard(shard))
     return pkg
@@ -246,9 +249,10 @@ def drop_shard(store: KVStore, shard: int) -> None:
             t.n_ops[shard] = 0
             t.slots_ub[shard] = 0
         t.used_rows[shard] = 0
-    store.directory = {
-        dk: ent for dk, ent in store.directory.items() if ent[1] != shard
-    }
+    # index-driven relinquish: drop exactly the shard's keys instead of
+    # rebuilding the whole directory (ISSUE 10 satellite)
+    for dk in list(store.directory.shard_keys(shard)):
+        del store.directory[dk]
     store.applied_vc[shard] = 0
     if store.log is not None:
         # the moved records must not resurrect here on the next recover

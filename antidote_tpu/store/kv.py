@@ -123,16 +123,19 @@ def shard_digest(store: "KVStore", shard: int) -> str:
 
     Caller must hold the commit lock (the clock and the heads must be
     one cut).  Cost: one device gather per touched table + one decode
-    per key — a periodic-check price, not a serving-path one.
+    per key — a periodic-check price, not a serving-path one.  The
+    shard's keys come from the directory's per-shard index
+    (:class:`ShardDirectory`), not an O(total keys) filter under the
+    lock.
     """
     import hashlib
 
     import msgpack as _mp
 
     objs = []
-    for (key, bucket), (tname, s, _row) in store.directory.items():
-        if s == shard:
-            objs.append((key, split_tier(tname)[0], bucket))
+    for key, bucket in store.directory.shard_keys(shard):
+        tname = store.directory[(key, bucket)][0]
+        objs.append((key, split_tier(tname)[0], bucket))
     objs.sort(key=lambda o: _mp.packb([o[0], o[2], o[1]],
                                       use_bin_type=True, default=repr))
     h = hashlib.sha256()
@@ -152,6 +155,74 @@ def freeze_key(key: Any) -> Any:
     if isinstance(key, list):
         return tuple(freeze_key(k) for k in key)
     return key
+
+
+class ShardDirectory(dict):
+    """``(key, bucket) -> (tiered_name, shard, row)`` with a per-shard
+    key index (ISSUE 10 satellite / ROADMAP item 2 residual).
+
+    Shard-scoped sweeps — divergence digests, handoff export, shard
+    relinquish — used to filter the whole O(total keys) directory under
+    the owner's commit lock.  The index makes them O(shard keys): lazy
+    (bulk ``update``/construction stay one C-speed dict pass and just
+    drop the index; the first :meth:`shard_keys` rebuilds it once), then
+    maintained incrementally by every ``[dk] = ent`` / ``pop`` / ``del``.
+    Merkle-style splitting of the digests themselves stays future work.
+    """
+
+    __slots__ = ("_by_shard",)
+
+    def __init__(self, items=()):
+        super().__init__(items)
+        self._by_shard = None  # lazy — built on first shard_keys()
+
+    def __setitem__(self, dk, ent):
+        idx = self._by_shard
+        if idx is not None:
+            old = dict.get(self, dk)
+            if old is not None and old[1] != ent[1]:
+                s = idx.get(old[1])
+                if s is not None:
+                    s.discard(dk)
+            idx.setdefault(ent[1], set()).add(dk)
+        dict.__setitem__(self, dk, ent)
+
+    def __delitem__(self, dk):
+        ent = dict.pop(self, dk)
+        idx = self._by_shard
+        if idx is not None:
+            s = idx.get(ent[1])
+            if s is not None:
+                s.discard(dk)
+
+    def pop(self, dk, *default):
+        idx = self._by_shard
+        if idx is not None and dk in self:
+            s = idx.get(dict.__getitem__(self, dk)[1])
+            if s is not None:
+                s.discard(dk)
+        return dict.pop(self, dk, *default)
+
+    def update(self, *a, **kw):  # noqa — bulk path: index rebuilds lazily
+        self._by_shard = None
+        dict.update(self, *a, **kw)
+
+    def clear(self):
+        dict.clear(self)
+        self._by_shard = {}
+
+    def shard_keys(self, shard: int):
+        """The shard's directory keys — the live index set when the
+        shard has entries (copy before mutating the directory while
+        iterating), an empty frozenset otherwise (consistent set
+        semantics either way; never an accidentally-mutable miss)."""
+        idx = self._by_shard
+        if idx is None:
+            idx = {}
+            for dk, ent in self.items():
+                idx.setdefault(ent[1], set()).add(dk)
+            self._by_shard = idx
+        return idx.get(shard, frozenset())
 
 
 def key_to_shard(key: Any, bucket: str, n_shards: int) -> int:
@@ -328,8 +399,14 @@ class KVStore:
     def __init__(self, cfg: AntidoteConfig, sharding=None, log=None):
         self.cfg = cfg
         self.sharding = sharding
+        #: MeshServingPlane when the serving plane is sharded over a
+        #: device mesh (ISSUE 10); attached via MeshServingPlane.attach.
+        #: Routes stable-time through the pmin collective and epoch
+        #: gathers through the routed shard_map path.
+        self.mesh = None
         self.tables: Dict[str, TypedTable] = {}
-        self.directory: Dict[Tuple[Any, str], Tuple[str, int, int]] = {}
+        self.directory: Dict[Tuple[Any, str], Tuple[str, int, int]] = (
+            ShardDirectory())
         self.blobs = BlobStore()
         #: optional LogManager — when set, effects are logged (with blob
         #: payloads) before the device tables observe them
@@ -732,13 +809,23 @@ class KVStore:
                     if m is not None:
                         m.epoch_publish.inc(mode="defer")
                     return "deferred"
-                slot, mode, tch, rows = res
+                slot, mode, tch, rows, shard_rows = res
                 tch = None if (tch is None or pend is None) else tch | pend
                 t._pending_touched = tch
                 touched[tname] = tch
                 if m is not None:
                     m.epoch_publish.inc(mode=mode)
                     m.epoch_rows.inc(rows, mode=mode)
+                    if self.mesh is not None:
+                        # per-shard incremental publish observable
+                        # (ISSUE 10): a scatter republishes exactly the
+                        # dirty shards' device slices; a full copy
+                        # rebuilds every slice
+                        sr = (shard_rows if shard_rows is not None
+                              else {s: t.n_rows
+                                    for s in range(self.cfg.n_shards)})
+                        for s, n in sr.items():
+                            m.mesh_publish.inc(n, shard=s)
             else:
                 touched[tname] = pend  # clean since the last success
             slots[tname] = t.serving_slot()
@@ -952,17 +1039,35 @@ class KVStore:
             t = self.table(tname_t)
             slot = ep.tables[tname_t]
             mcount = len(items)
-            mb = _bucket(mcount, t.cfg.batch_buckets)
-            ss = np.zeros(mb, np.int64)
-            rr = np.zeros(mb, np.int64)
-            ss[:mcount] = [x[1] for x in items]
-            rr[:mcount] = [x[2] for x in items]
-            vcs = np.zeros((mb, ep.vc.shape[-1]), np.int32)
-            vcs[:mcount] = ep.vc
-            resolved, fresh = t._latest_resolved_flat_fn(
-                slot["head"], slot["head_vc"], ss, rr, vcs
-            )
-            launches.append((tname_t, items, resolved, fresh))
+            if self.mesh is not None and t.sharding is not None:
+                # mesh table (ISSUE 10): ROUTED per-shard gather through
+                # the explicit shard_map — each device gathers its own
+                # shards' rows from its local slice of the frozen epoch
+                # buffers; the result stays one (sharded) device array,
+                # no host-side concat on the hot path
+                ss = np.asarray([x[1] for x in items], np.int64)
+                rr = np.asarray([x[2] for x in items], np.int64)
+                row_mat, pos = t._route(ss, rr)
+                row_gather = np.minimum(row_mat, t.n_rows - 1)
+                p, mm = row_mat.shape
+                vc_mat = np.zeros((p, mm, ep.vc.shape[-1]), np.int32)
+                vc_mat[pos[:, 0], pos[:, 1]] = ep.vc
+                resolved, fresh = self.mesh.epoch_gather(
+                    t, slot["head"], slot["head_vc"], row_gather, vc_mat
+                )
+                launches.append((tname_t, items, resolved, fresh, pos))
+            else:
+                mb = _bucket(mcount, t.cfg.batch_buckets)
+                ss = np.zeros(mb, np.int64)
+                rr = np.zeros(mb, np.int64)
+                ss[:mcount] = [x[1] for x in items]
+                rr[:mcount] = [x[2] for x in items]
+                vcs = np.zeros((mb, ep.vc.shape[-1]), np.int32)
+                vcs[:mcount] = ep.vc
+                resolved, fresh = t._latest_resolved_flat_fn(
+                    slot["head"], slot["head_vc"], ss, rr, vcs
+                )
+                launches.append((tname_t, items, resolved, fresh, None))
             if m is not None:
                 m.serving_reads.inc(mcount, path="gather")
         return _EpochReadPending(ep, objects, vals, launches), fallback
@@ -976,15 +1081,22 @@ class KVStore:
 
         ep = pending.ep
         vals = pending.vals
-        for tname_t, items, resolved, fresh in pending.launches:
+        for tname_t, items, resolved, fresh, pos in pending.launches:
             t = self.table(tname_t)
             ty = t.ty
+            # routed (mesh) launches materialize the global [P, M']
+            # array in ONE transfer here — the writeback stage owns the
+            # sync; unrouting is host indexing, never a concat loop
             host = {f: np.asarray(x) for f, x in resolved.items()}
             del fresh  # provably all-fresh: frozen head_vc ≤ cap ≤ E
             has_resolve = ty.resolve_spec(t.cfg) is not None
             slot = ep.tables[tname_t]
             for j, (i, shard, row) in enumerate(items):
-                view = {f: x[j] for f, x in host.items()}
+                if pos is not None:
+                    view = {f: x[pos[j, 0], pos[j, 1]]
+                            for f, x in host.items()}
+                else:
+                    view = {f: x[j] for f, x in host.items()}
                 if has_resolve:
                     v = ty.value_from_resolved(view, self.blobs, t.cfg)
                     if v is RESOLVE_OVERFLOW:
@@ -1460,10 +1572,15 @@ class KVStore:
     def stable_vc(self) -> np.ndarray:
         """DC-wide stable snapshot = entry-wise min of per-shard clocks
         (stable_time_functions:get_min_time,
-        /root/reference/src/stable_time_functions.erl:51-85).  Routed
+        /root/reference/src/stable_time_functions.erl:51-85).  A
+        mesh-resident store (ISSUE 10) computes it as the ``pmin``
+        collective over the per-device applied clocks — identical by
+        construction, cached per clock version; otherwise it routes
         through :func:`stable_min_of`, which keeps the usual
         ``n_shards``-row matrix on host and dispatches large matrices
         (many nodes × shards) to the streaming Pallas kernel."""
+        if self.mesh is not None:
+            return self.mesh.stable_vc(self.applied_vc)
         return stable_min_of(self.applied_vc, getattr(self.cfg, "use_pallas", False))
 
     def dc_max_vc(self) -> np.ndarray:

@@ -349,16 +349,45 @@ class TypedTable:
             self._freeze_scatter_fns[bucket] = fn
         return fn
 
+    def _freeze_scatter_shard_for(self, bucket: int):
+        """ROUTED incremental freeze for mesh-placed tables (ISSUE 10):
+        the dirty rows arrive as a per-shard padded row matrix
+        ``[P, M']`` (padding = n_rows → gather clips, scatter drops), so
+        each device scatters only its OWN shards' rows into its local
+        slice of the donated spare — a clean shard's device slice is
+        untouched, and one hot shard's write burst republishes exactly
+        its own slice.  One compile per padded-per-shard bucket."""
+        fn = self._freeze_scatter_fns.get(("shard", bucket))
+        if fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def fn(sp_head, sp_vc, head, head_vc, row_mat):
+                sidx = jnp.arange(row_mat.shape[0])[:, None]
+                out = {
+                    f: x.at[sidx, row_mat].set(head[f][sidx, row_mat],
+                                               mode="drop")
+                    for f, x in sp_head.items()
+                }
+                return out, sp_vc.at[sidx, row_mat].set(
+                    head_vc[sidx, row_mat], mode="drop")
+
+            self._freeze_scatter_fns[("shard", bucket)] = fn
+        return fn
+
     def freeze_serving(self, can_donate: bool, force_copy: bool = False):
         """Freeze the live head into the spare serving slot and make it
-        current.  Returns (slot, mode, touched, rows): mode "scatter"
-        (incremental — ``rows`` rows re-frozen) or "copy" (full).
-        ``touched`` is the frozenset of rows WRITTEN since the previous
-        publish (one window — the snapshot cache's validity set; the
-        scatter set itself spans two windows, one per buffer slot), or
-        None when unknown (untracked overflow / after an out-of-band
-        invalidation).  Returns None when the freeze must be DEFERRED
-        (the spare may still be read by a pinned epoch and cannot be
+        current.  Returns (slot, mode, touched, rows, shard_rows): mode
+        "scatter" (incremental — ``rows`` rows re-frozen) or "copy"
+        (full).  ``touched`` is the frozenset of rows WRITTEN since the
+        previous publish (one window — the snapshot cache's validity
+        set; the scatter set itself spans two windows, one per buffer
+        slot), or None when unknown (untracked overflow / after an
+        out-of-band invalidation).  ``shard_rows`` maps shard → rows
+        re-frozen in that shard's slice (the mesh plane's per-shard
+        publish observable; tracked only for mesh-placed tables), or
+        None — a full copy (every slice rebuilt) or an untracked
+        single-chip scatter.  Returns None when the freeze must be
+        DEFERRED (the
+        spare may still be read by a pinned epoch and cannot be
         donated).  ``force_copy`` rebuilds the slot from scratch instead
         of donating — required when the spare is still referenced by the
         LIVE epoch (a partial publish left it there; waiting can never
@@ -370,22 +399,41 @@ class TypedTable:
         dirty = self._serving_spare_dirty
         if force_copy or spare is None or dirty is None:
             frozen = self._copy_tree_fn((self.head, self.head_vc))
-            mode, rows = "copy", self.n_shards * self.n_rows
+            mode, rows, shard_rows = "copy", self.n_shards * self.n_rows, None
         elif not can_donate:
             return None
         else:
             pairs = sorted(dirty)
             m = len(pairs)
-            mb = _bucket(max(m, 1), self.cfg.batch_buckets)
-            ss = np.full(mb, self.n_shards, np.int64)
-            rr = np.zeros(mb, np.int64)
-            ss[:m] = [p[0] for p in pairs]
-            rr[:m] = [p[1] for p in pairs]
-            # padding uses shard index P (out of range): the scatter
-            # drops it, and the matching gather clips harmlessly
-            fn = self._freeze_scatter_for(mb)
-            frozen = fn(spare["head"], spare["head_vc"],
-                        self.head, self.head_vc, ss, rr)
+            shard_rows = None
+            if self.sharding is not None:
+                # per-shard counts are only consumed by the mesh
+                # publisher — single-chip publishes skip the loop
+                shard_rows = {}
+                for s, _ in pairs:
+                    shard_rows[int(s)] = shard_rows.get(int(s), 0) + 1
+                # mesh-placed table: route the dirty rows per shard so
+                # each device scatters only its own slice — a clean
+                # shard's device slice is untouched (ISSUE 10).  Same
+                # n_rows-padded [P, M'] layout the epoch gather uses.
+                row_mat, _pos = self._route(
+                    np.asarray([p[0] for p in pairs], np.int64),
+                    np.asarray([p[1] for p in pairs], np.int64),
+                )
+                fn = self._freeze_scatter_shard_for(row_mat.shape[1])
+                frozen = fn(spare["head"], spare["head_vc"],
+                            self.head, self.head_vc, row_mat)
+            else:
+                mb = _bucket(max(m, 1), self.cfg.batch_buckets)
+                ss = np.full(mb, self.n_shards, np.int64)
+                rr = np.zeros(mb, np.int64)
+                ss[:m] = [p[0] for p in pairs]
+                rr[:m] = [p[1] for p in pairs]
+                # padding uses shard index P (out of range): the scatter
+                # drops it, and the matching gather clips harmlessly
+                fn = self._freeze_scatter_for(mb)
+                frozen = fn(spare["head"], spare["head_vc"],
+                            self.head, self.head_vc, ss, rr)
             mode, rows = "scatter", m
         slot = {"head": frozen[0], "head_vc": frozen[1],
                 "cap": self.max_commit_vc.copy()}
@@ -398,7 +446,7 @@ class TypedTable:
         self._serving_cur = spare_i
         self._serving_spare_dirty = self._serving_dirty
         self._serving_dirty = set()
-        return slot, mode, touched, rows
+        return slot, mode, touched, rows, shard_rows
 
     # ------------------------------------------------------------------
     # row allocation / growth

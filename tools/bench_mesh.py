@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Mesh serving-plane benchmark: 1/2/4/8-device serving-read curves
+(ISSUE 10).
+
+Each device count runs in a FRESH subprocess (its own XLA backend with
+8 forced virtual CPU devices, mesh over the first N), so compile caches
+and device state never bleed between curves:
+
+  populate  — N counter keys through apply_effects (the mesh placement
+              path), one serving-epoch publish
+  measure   — merged epoch-read batches (launch + finish — exactly the
+              wire dispatcher/writeback split) for a fixed window;
+              per-batch gather-launch and fold/materialize stage times
+              recorded separately
+  extras    — stable-time pmin collective latency (forced cache
+              misses), per-shard incremental publish cost for a
+              one-shard burst, and a value-parity spot check against
+              the locked read path
+
+The parent freezes BENCH_MESH_cpu.json.  STRUCTURAL gates only
+(--assert-bounds): every curve present, nonzero throughput, parity
+clean, burst publish rows == dirty rows (never table size).  Never a
+throughput ratchet — this 2-core shared container cannot hold one (see
+host_note); the ROADMAP ≥6x 1→8-device target is the REAL-TPU success
+metric, with this CPU-container curve as the frozen proxy.
+
+Usage:
+  python tools/bench_mesh.py --smoke --assert-bounds       # CI gate
+  python tools/bench_mesh.py --json BENCH_MESH_cpu.json    # freeze
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_T0 = time.time()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEVICE_CURVE = (1, 2, 4, 8)
+
+HOST_NOTE = (
+    "2-core shared CPU container: the 8 'devices' are XLA host-platform "
+    "threads multiplexed over 2 cores with co-tenant load (adjacent "
+    "windows swing several x — see BENCH_WIRE host_note), so the curve "
+    "measures the mesh plane's STRUCTURE (routed shard-local gathers, "
+    "per-shard publishes, pmin collective), not chip scaling.  The "
+    "ROADMAP item-3 success metric — device-kernel reads/s scaling "
+    ">=6x from 1->8 devices — is judged on real ICI-connected TPU "
+    "hardware; this artifact is the frozen CPU proxy."
+)
+
+
+def log(*a):
+    print(f"[mesh {time.time() - _T0:6.1f}s]", *a, file=sys.stderr,
+          flush=True)
+
+
+# ---------------------------------------------------------------------------
+# child: one device count, one fresh backend
+# ---------------------------------------------------------------------------
+def run_child(n_dev: int, n_keys: int, window_s: float,
+              batch: int) -> dict:
+    import numpy as np
+
+    from antidote_tpu.config import (AntidoteConfig,
+                                     enable_compilation_cache)
+
+    enable_compilation_cache()
+    from antidote_tpu.crdt import get_type
+    from antidote_tpu.obs import NodeMetrics
+    from antidote_tpu.parallel import MeshServingPlane
+    from antidote_tpu.store.kv import Effect, KVStore
+
+    cfg = AntidoteConfig(
+        n_shards=8, max_dcs=2,
+        keys_per_table=max(n_keys, 1024), batch_buckets=(64, 512, 4096),
+    )
+    plane = MeshServingPlane(cfg, n_dev)
+    store = KVStore(cfg, sharding=plane.sharding)
+    store.metrics = NodeMetrics()
+    plane.attach(store)
+    ty = get_type("counter_pn")
+    aw, bw = ty.eff_a_width(cfg), ty.eff_b_width(cfg)
+
+    t0 = time.monotonic()
+    counter = 0
+    chunk = 4096
+    for lo in range(0, n_keys, chunk):
+        keys = range(lo, min(lo + chunk, n_keys))
+        effs = [Effect(k, "counter_pn", "b",
+                       np.full(aw, (k % 97) + 1, np.int64),
+                       np.zeros(bw, np.int32)) for k in keys]
+        vcs = []
+        for _ in keys:
+            counter += 1
+            vcs.append(np.asarray([counter, 0], np.int32))
+        store.apply_effects(effs, vcs, [0] * len(effs))
+    populate_s = time.monotonic() - t0
+    store.publish_serving_epoch(store.dc_max_vc())
+
+    rng = np.random.default_rng(11)
+
+    def one_batch():
+        ks = rng.integers(0, n_keys, size=batch)
+        objs = [(int(k), "counter_pn", "b") for k in ks]
+        ep = store.pin_serving_epoch()
+        t1 = time.monotonic()
+        pending, fb = store.epoch_read_launch(objs, ep)
+        t2 = time.monotonic()
+        vals = store.epoch_read_finish(pending)
+        t3 = time.monotonic()
+        store.unpin_serving_epoch(ep)
+        assert not fb
+        return len(vals), t2 - t1, t3 - t2
+
+    # shape warm: bucket-family compiles land before the window
+    for _ in range(3):
+        one_batch()
+    n_reads = 0
+    launch_s = fold_s = 0.0
+    t_end = time.monotonic() + window_s
+    t_start = time.monotonic()
+    batches = 0
+    while time.monotonic() < t_end:
+        n, dl, df = one_batch()
+        n_reads += n
+        launch_s += dl
+        fold_s += df
+        batches += 1
+    elapsed = time.monotonic() - t_start
+
+    # parity spot check vs the locked read path
+    ks = rng.integers(0, n_keys, size=min(256, n_keys))
+    objs = [(int(k), "counter_pn", "b") for k in ks]
+    ep = store.pin_serving_epoch()
+    pending, fb = store.epoch_read_launch(objs, ep)
+    got = store.epoch_read_finish(pending)
+    store.unpin_serving_epoch(ep)
+    want = store.read_values(objs, store.dc_max_vc())
+    parity_ok = (not fb) and got == want
+
+    # stable-time pmin collective: force cache misses
+    pmin_us = []
+    for i in range(10):
+        store.applied_vc[0, 0] += 1
+        t1 = time.monotonic()
+        store.stable_vc()
+        pmin_us.append((time.monotonic() - t1) * 1e6)
+    pmin_us.sort()
+
+    # per-shard incremental publish: one-shard burst (two publishes
+    # drain the cross-window scatter set first)
+    def burst(keys):
+        nonlocal counter
+        effs = [Effect(int(k), "counter_pn", "b",
+                       np.full(aw, 1, np.int64), np.zeros(bw, np.int32))
+                for k in keys]
+        vcs = []
+        for _ in keys:
+            counter += 1
+            vcs.append(np.asarray([counter, 0], np.int32))
+        store.apply_effects(effs, vcs, [0] * len(effs))
+
+    burst([8 * i + 3 for i in range(16)])   # shard 3
+    store.publish_serving_epoch(store.dc_max_vc())
+    burst([8 * i + 3 for i in range(16)])
+    store.publish_serving_epoch(store.dc_max_vc())
+    burst([8 * i + 3 for i in range(16)])
+    m = store.metrics
+    before = dict(m.mesh_publish.snapshot())
+    t1 = time.monotonic()
+    store.publish_serving_epoch(store.dc_max_vc())
+    burst_publish_ms = (time.monotonic() - t1) * 1e3
+    delta = {k[0]: v - before.get(k, 0)
+             for k, v in m.mesh_publish.snapshot().items()}
+    burst_rows = {k: int(v) for k, v in delta.items() if v}
+
+    return {
+        "n_devices": n_dev,
+        "n_keys": n_keys,
+        "batch": batch,
+        "reads_per_s": round(n_reads / elapsed, 1),
+        "batches": batches,
+        "gather_launch_us_mean": round(launch_s / max(batches, 1) * 1e6,
+                                       1),
+        "fold_materialize_us_mean": round(fold_s / max(batches, 1) * 1e6,
+                                          1),
+        "stable_pmin_us_p50": round(pmin_us[len(pmin_us) // 2], 1),
+        "burst_publish_rows_by_shard": burst_rows,
+        "burst_publish_ms": round(burst_publish_ms, 2),
+        "populate_s": round(populate_s, 2),
+        "parity_ok": bool(parity_ok),
+    }
+
+
+# ---------------------------------------------------------------------------
+# parent: curve over device counts, artifact freeze, structural gates
+# ---------------------------------------------------------------------------
+def run_parent(args) -> int:
+    results = {}
+    for n_dev in DEVICE_CURVE:
+        log(f"curve point: {n_dev} device(s)")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one",
+             str(n_dev), "--keys", str(args.keys), "--window",
+             str(args.window), "--batch", str(args.batch)],
+            capture_output=True, text=True, cwd=_REPO, env=env,
+            timeout=1800,
+        )
+        if out.returncode != 0:
+            log(f"child {n_dev} FAILED:\n{out.stderr[-2000:]}")
+            return 1
+        results[str(n_dev)] = json.loads(out.stdout.strip().splitlines()[-1])
+        log(f"  -> {results[str(n_dev)]['reads_per_s']} reads/s")
+
+    r1 = results["1"]["reads_per_s"]
+    r8 = results["8"]["reads_per_s"]
+    artifact = {
+        "metric": "mesh_serving_read_scaling",
+        "unit": "epoch-plane reads/s by mesh device count",
+        "driver_rev": 1,
+        "curves": results,
+        "scaling_1_to_8": round(r8 / r1, 2) if r1 else None,
+        "target": {
+            "metric": "device-kernel reads/s scale >=6x from 1->8 "
+                      "devices on real TPU (ROADMAP item 3); >=10x vs "
+                      "BASELINE.json when hardware is available",
+            "cpu_proxy": "this artifact freezes the container curve; "
+                         "never gated on throughput",
+        },
+        "host_note": HOST_NOTE,
+        "smoke": bool(args.smoke),
+        "created_at": time.time(),
+    }
+    if args.json:
+        path = os.path.join(_REPO, args.json)
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        log(f"froze {args.json}")
+    else:
+        print(json.dumps(artifact, indent=1))
+    if args.assert_bounds:
+        # STRUCTURAL gates only (never a throughput ratchet)
+        for n_dev in DEVICE_CURVE:
+            r = results[str(n_dev)]
+            assert r["reads_per_s"] > 0, (n_dev, "zero throughput")
+            assert r["parity_ok"], (n_dev, "mesh/locked parity broke")
+            rows = r["burst_publish_rows_by_shard"]
+            assert set(rows) == {"3"}, (
+                n_dev, "burst republished beyond its shard", rows)
+            assert rows["3"] <= 64, (
+                n_dev, "burst publish cost not ∝ dirty rows", rows)
+        log("structural gates OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--one", type=int, default=0,
+                    help="(internal) run one child curve point")
+    ap.add_argument("--keys", type=int, default=65536)
+    ap.add_argument("--window", type=float, default=3.0)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small keys + short window (CI)")
+    ap.add_argument("--assert-bounds", action="store_true")
+    ap.add_argument("--json", default=None,
+                    help="freeze the artifact to this repo-relative path")
+    args = ap.parse_args(argv)
+    if args.smoke and args.keys == 65536:
+        args.keys, args.window = 8192, 1.0
+    if args.one:
+        from antidote_tpu.config import apply_jax_platform_env
+
+        apply_jax_platform_env()
+        print(json.dumps(run_child(args.one, args.keys, args.window,
+                                   args.batch)))
+        return 0
+    return run_parent(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,20 +187,27 @@ def _check_unbounded_queues(tree, path, lines, problems) -> None:
 #: files that ARE the wire-serving hot path: a device sync on a
 #: dispatcher-stage thread stalls every parked request behind one
 #: materialize (the staged pipeline confines syncs to the writeback
-#: stage) — ISSUE 5 discipline, mirroring the unbounded-queue rule
+#: stage) — ISSUE 5 discipline, mirroring the unbounded-queue rule.
+#: The mesh serving plane (antidote_tpu/parallel/, ISSUE 10) is held to
+#: the same bar: its launch/placement/collective paths run on
+#: dispatcher-stage threads, so a sync there must carry the same
+#: written justification.
 _SERVING_HOT_PATH = (os.path.join("antidote_tpu", "proto", "server.py"),)
+_SERVING_HOT_PLANES = (os.path.join("antidote_tpu", "parallel") + os.sep,)
 _SYNC_TOKENS = ("block_until_ready(", ".item()", "np.asarray(")
 
 
 def _check_serving_syncs(path, lines, problems) -> None:
-    """In the serving hot path, flag device-sync idioms —
+    """In the serving hot path — proto/server.py and the whole mesh
+    plane (antidote_tpu/parallel/) — flag device-sync idioms:
     ``block_until_ready(``, ``.item()``, ``np.asarray(`` — unless a
     ``# sync-ok: <reason>`` annotation on the line or within the three
     preceding lines justifies it (e.g. the writeback stage, which owns
     the sync, or a conversion of host data that never touches a jax
     array)."""
     norm = os.path.normpath(path)
-    if not any(norm.endswith(p) for p in _SERVING_HOT_PATH):
+    if not (any(norm.endswith(p) for p in _SERVING_HOT_PATH)
+            or any(pl in norm for pl in _SERVING_HOT_PLANES)):
         return
 
     def annotated(lineno: int) -> bool:
