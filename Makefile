@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke mesh-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke fleet-smoke mesh-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -59,6 +59,15 @@ restart-smoke:
 replica-smoke:
 	$(PY) -m pytest tests/test_follower.py -q
 	$(PY) bench_wire.py --follower-fanout --smoke --assert-bounds
+
+# planet-scale session fabric (ISSUE 11): the session-algebra/ring/apb
+# property suite plus one live hash-routed 4-follower fanout point with
+# the COVERAGE gate — zero session violations and every follower's ring
+# arcs actually served reads.  STRUCTURAL only; the frozen 8-follower
+# curve in BENCH_WIRE_cluster_cpu.json is never a ratchet
+fleet-smoke:
+	$(PY) -m pytest tests/test_session_fabric.py -q
+	$(PY) bench_wire.py --fleet-smoke --assert-bounds
 
 # mesh serving plane (ISSUE 10): the deterministic mesh suite on the
 # forced 8-device CPU mesh (read parity byte-identical with the

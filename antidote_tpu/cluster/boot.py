@@ -63,7 +63,10 @@ def main(argv=None) -> int:
     fabric = TcpFabric()
     replica = attach_interdc(member, fabric)
     node = ClusterNode(member)
-    server = ProtocolServer(node, port=0)
+    # interdc=replica: this member's wire server answers
+    # GET_CONNECTION_DESCRIPTOR (and replica-status), so followers can
+    # learn the fleet's endpoints member by member (ISSUE 11)
+    server = ProtocolServer(node, port=0, interdc=replica)
 
     subscribed = set()
 

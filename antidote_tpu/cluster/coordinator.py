@@ -523,6 +523,15 @@ class ClusterNode:
         self._txns.pop(txn.txid, None)
 
     # ------------------------------------------------------------------
+    def checkpoint_now(self) -> dict:
+        """Run one synchronous checkpoint cycle on THIS member's store
+        (console `checkpoint-now --port <member>`): each member of a
+        clustered DC publishes its own image, and a follower composing
+        the fleet installs every member's image restricted to its owned
+        shards (ISSUE 11) — so the operator checkpoints members
+        individually, exactly like single-node owners."""
+        return self.member.node.checkpoint_now()
+
     def check_ready(self) -> Dict[str, bool]:
         probes = {"local": True}
         for mid, cli in self.member.peers.items():
@@ -537,6 +546,10 @@ class ClusterNode:
             "dc_id": self.dc_id,
             "member": self.member.member_id,
             "members": self.member.n_members,
+            # deployment shape, so a follower bootstrapping off this
+            # member (console --follower-of) can adopt it (ISSUE 11)
+            "n_shards": self.cfg.n_shards,
+            "max_dcs": self.cfg.max_dcs,
             "owned_shards": sorted(self.member.shards),
             "stable_vc": [int(x) for x in self.member.stable_vc()],
         }

@@ -34,6 +34,19 @@ def _parse_arg(raw: str):
         return raw
 
 
+def _parse_endpoints(csv: str):
+    """``host:port[,host:port...]`` -> [(host, port)] (the --follower-of
+    / --follower-peers fleet lists)."""
+    out = []
+    for part in csv.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        h, p = part.rsplit(":", 1)
+        out.append((h, int(p)))
+    return out
+
+
 def resolve_serve_shape(log_dir, shards, max_dcs):
     """Deployment shape for ``serve``: an explicit flag wins; otherwise an
     existing log dir's recorded {n_shards, max_dcs}; otherwise the
@@ -73,15 +86,22 @@ def cmd_serve(args) -> int:
     _faults.install_from_env()
 
     owner_addr = None
+    owner_addrs = []
     if args.follower_of:
-        # follower replica (ISSUE 9): adopt the OWNER's deployment shape
-        # and dc lane — a follower is a replica of that exact store
+        # follower replica (ISSUE 9/11): adopt the OWNER's deployment
+        # shape and dc lane — a follower is a replica of that exact
+        # store.  A CLUSTERED owner is given as a comma-separated list
+        # of its members' client endpoints; the first one is the write
+        # endpoint named in typed redirects
         if args.log_dir is None:
             log("--follower-of requires --log-dir (followers install "
                 "checkpoint images into a durable WAL)")
             return 2
-        oh, op_ = args.follower_of.rsplit(":", 1)
-        owner_addr = (oh, int(op_))
+        owner_addrs = _parse_endpoints(args.follower_of)
+        if not owner_addrs:
+            log("--follower-of needs at least one HOST:PORT endpoint")
+            return 2
+        owner_addr = owner_addrs[0]
         from antidote_tpu.proto.client import AntidoteClient
 
         try:
@@ -241,21 +261,30 @@ def cmd_serve(args) -> int:
     ready: dict = {"host": server.host, "port": server.port, "ready": True}
     if follower is not None:
         # attach AFTER the fabric pump + server are supervised: the
-        # bootstrap ships the owner's image, catches the tail up, then
+        # bootstrap ships the fleet's images, catches the tails up, then
         # subscribes — only then is the ready line printed, so drivers
-        # can gate on a SERVING follower
+        # can gate on a SERVING follower.  Every owner-DC member's
+        # descriptor is fetched (clustered owners), plus any
+        # --follower-peers (geo owners: the peer DCs' origin chains
+        # replicate live through the follower's own subscriptions)
         from antidote_tpu.proto.client import AntidoteClient
 
-        oc = AntidoteClient(*owner_addr)
-        desc = oc.get_connection_descriptor()
-        oc.close()
+        peer_addrs = (_parse_endpoints(args.follower_peers)
+                      if args.follower_peers else [])
+        descs = []
+        for addr in owner_addrs + peer_addrs:
+            oc = AntidoteClient(*addr)
+            descs.append(oc.get_connection_descriptor())
+            oc.close()
         follower.client_addr = (args.public_host or server.host,
                                 server.port)
-        mode = follower.attach(desc)
+        mode = follower.attach(descs)
         ready.update({"role": "follower", "bootstrap": mode,
-                      "name": follower.name})
+                      "name": follower.name,
+                      "fleet": {"owner_members": len(owner_addrs),
+                                "peer_dcs": len(peer_addrs)}})
         log(f"follower {follower.name} of {args.follower_of} serving "
-            f"(bootstrap mode={mode})")
+            f"(bootstrap mode={mode}, owner members={len(owner_addrs)})")
     if mesh_plane is not None:
         ready["mesh_devices"] = mesh_plane.n_devices
     log(f"antidote_tpu dc{args.dc_id} serving on "
@@ -404,11 +433,22 @@ def cmd_inspect_checkpoint(args) -> int:
 def cmd_replica_status(args) -> int:
     """Replica-plane view: against an owner, every known follower with
     its typed state (ok | lagging | down | bootstrapping | healing) and
-    applied-VC lag; against a follower, its own state/bootstrap/
-    divergence view.  Exit 1 when any follower is not ok."""
+    applied-VC lag — plus the consistent-hash ring a SessionClient
+    would build over the serving fleet (size + per-endpoint arc
+    shares); against a follower, its own state/bootstrap/divergence
+    view.  Exit 1 when any follower is not ok."""
     c = _client(args)
     out = c.replica_admin("status")
     c.close()
+    serving = [(f["addr"][0], int(f["addr"][1]))
+               for f in (out.get("followers") or {}).values()
+               if f.get("addr") and f.get("state") in ("ok", "lagging")]
+    if serving:
+        from antidote_tpu.proto.client import HashRing
+
+        ring = HashRing(serving)
+        out["ring"] = {"size": len(ring),
+                       "arc_share": ring.arc_share_by_name()}
     print(json.dumps(out, indent=2))
     bad = [n for n, f in (out.get("followers") or {}).items()
            if f.get("state") != "ok"]
@@ -542,14 +582,27 @@ def main(argv=None) -> int:
                     help="attach the inter-DC replication plane (TCP "
                          "fabric + replica) so clients can bootstrap a "
                          "DC mesh over the protocol")
-    sv.add_argument("--follower-of", default=None, metavar="HOST:PORT",
+    sv.add_argument("--follower-of", default=None,
+                    metavar="HOST:PORT[,HOST:PORT...]",
                     help="boot as a READ REPLICA of the owner serving at "
                          "HOST:PORT (its client protocol port; the owner "
                          "must run --interdc): bootstraps from the "
                          "owner's checkpoint image / WAL tail, subscribes "
                          "to its txn stream, serves session reads, "
-                         "refuses writes with a typed redirect.  "
+                         "refuses writes with a typed redirect.  A "
+                         "CLUSTERED owner is the comma-separated list of "
+                         "ALL its members' client endpoints (per-member "
+                         "image composition + per-shard routed catch-up; "
+                         "the first endpoint is named in redirects).  "
                          "Requires --log-dir; adopts the owner's shape")
+    sv.add_argument("--follower-peers", default=None,
+                    metavar="HOST:PORT[,...]",
+                    help="with --follower-of against a GEO-REPLICATED "
+                         "owner: the peer DCs' client endpoints, so "
+                         "their origin chains replicate live through "
+                         "the follower's own subscriptions (without "
+                         "this, unsubscribed peer lanes show as "
+                         "permanently 'skipped' divergence checks)")
     sv.add_argument("--replica-name", default=None,
                     help="follower name in the owner's replica registry "
                          "(default: follower-<dc>-<pid>)")

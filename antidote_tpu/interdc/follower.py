@@ -33,11 +33,24 @@ built to *survive anything*:
     the client fails over (across followers, and back to the owner)
     with read-your-writes and monotonic reads intact.
 
-Scope: the follower follows a single-member owner DC's own-origin
-chain.  A geo-replicated owner's remote-origin effects reach the
-follower only through image bootstraps (their live chains are not
-re-published by the owner) — wiring followers into a full DC mesh is a
-recorded residual.
+Fleet scope (ISSUE 11): a follower can shadow a CLUSTERED (multi-member)
+or GEO-REPLICATED owner.  :meth:`FollowerReplica.attach` accepts a list
+of descriptors — every member of the owner DC, plus (for geo owners) the
+peer DCs' endpoints — and opens one stream subscription per endpoint.
+Per-shard request routing (catch-up, divergence digests, image fetches)
+rides the epoch'd ownership gossip from PR 3 (``DCReplica.shard_route``:
+every egress message carries the publishing member's (owner, epoch)
+stamp), so a mid-fleet shard move re-points the follower's catch-up and
+digest checks at the new owner with NO reconnect — the already-open
+subscription to the new owner simply keeps delivering.  Bootstrap and
+quarantine repair COMPOSE per-member checkpoint images: each member's
+image installs restricted to the shards that member currently owns
+(``install_image(shards=...)``), and the divergence digest compares each
+shard against whichever member owns it at the compared clock.  A geo
+owner's remote-origin chains replicate live through the follower's own
+subscriptions to the peer DCs (give ``attach`` their descriptors too —
+an unsubscribed peer lane shows up as a permanently ``skipped``
+divergence check, never a mismatch).
 """
 
 from __future__ import annotations
@@ -92,8 +105,22 @@ class FollowerReplica(DCReplica):
         #: <= 0 disables the periodic divergence sweep (tests call
         #: :meth:`check_divergence` directly; console arms it)
         self.digest_every_s = float(digest_every_s)
-        #: owner's fabric id — set by :meth:`attach`
+        #: owner's fabric id — set by :meth:`attach` (for a clustered
+        #: owner: the lowest-member-id endpoint, i.e. the sequencer)
         self.owner_fid: Optional[int] = None
+        #: the whole subscribed fleet: fabric id -> Descriptor (owner-DC
+        #: members AND geo peers); single-member owners have one entry
+        self.fleet: Dict[int, "Descriptor"] = {}
+        #: dc id -> sorted member fabric ids (the modular catch-up
+        #: fallback before any ownership gossip arrives; learned
+        #: ``shard_route`` entries take precedence via ``_route``)
+        self.fleet_by_dc: Dict[int, List[int]] = {}
+        #: owner-DC member fabric ids (liveness reports + image sources)
+        self.member_fids: List[int] = []
+        #: session-gate refusals since the last admitted read — the
+        #: pressure signal behind the typed redirect's retry hint
+        #: (PR 4's AdmissionGate discipline; benign under races)
+        self._gate_streak = 0
         #: bootstrapping -> serving -> (healing -> serving)*; anything
         #: but "serving" redirects every session read
         self.state = "bootstrapping"
@@ -152,27 +179,59 @@ class FollowerReplica(DCReplica):
 
     # -- attach / bootstrap ---------------------------------------------
     def attach(self, desc) -> str:
-        """Wire this follower to its owner from a connection descriptor
-        (the owner's ``GET_CONNECTION_DESCRIPTOR`` reply): learn the
-        endpoint, bootstrap (image / delta / tail), subscribe to the txn
-        stream, and close the bootstrap→subscribe window with one more
-        catch-up.  Returns the bootstrap mode."""
-        if isinstance(desc, dict):
-            desc = Descriptor.from_wire(desc)
-        self.owner_fid = (desc.fabric_id if desc.fabric_id is not None
-                          else desc.dc_id)
-        assert self.owner_fid != self.fabric_id, \
-            "follower fabric id collides with the owner's"
-        # every chain's catch-up (and every request) goes to the owner
-        self.route_query = lambda origin, shard: self.owner_fid
-        if desc.address is not None:
-            connect = getattr(self.hub, "connect_remote", None)
-            if connect is not None:
-                connect(self.owner_fid, desc.address[0],
-                        int(desc.address[1]))
+        """Wire this follower to its owner FLEET from connection
+        descriptor(s) (``GET_CONNECTION_DESCRIPTOR`` replies): one
+        descriptor for a single-member owner, or a list covering every
+        member of a clustered owner DC — plus, for a geo-replicated
+        owner, the peer DCs' descriptors, so their origin chains
+        replicate live through the follower's own subscriptions.  Learns
+        the endpoints, bootstraps (per-member image composition / delta
+        / tail), subscribes to every stream, and closes the
+        bootstrap→subscribe window with one more catch-up.  Returns the
+        bootstrap mode."""
+        descs = list(desc) if isinstance(desc, (list, tuple)) else [desc]
+        descs = [Descriptor.from_wire(d) if isinstance(d, dict) else d
+                 for d in descs]
+        fleet: Dict[int, Descriptor] = {}
+        for d in descs:
+            fid = d.fabric_id if d.fabric_id is not None else d.dc_id
+            assert fid != self.fabric_id, \
+                "follower fabric id collides with a fleet endpoint's"
+            fleet[fid] = d
+        by_dc: Dict[int, List[int]] = {}
+        for fid, d in fleet.items():
+            by_dc.setdefault(int(d.dc_id), []).append(fid)
+        for fids in by_dc.values():
+            # fabric_id_of is monotone in member id, so sorted fabric
+            # ids == member-id order (member 0 keeps the bare dc id)
+            fids.sort()
+        if self.dc_id not in by_dc:
+            raise ValueError(
+                f"no descriptor for the owner DC (dc_id={self.dc_id}) "
+                "in the fleet — a follower shadows that exact store")
+        self.fleet = fleet
+        self.fleet_by_dc = by_dc
+        self.member_fids = list(by_dc[self.dc_id])
+        self.owner_fid = self.member_fids[0]
+
+        def route(origin: int, shard: int) -> int:
+            # modular fallback over the origin's known members; the
+            # gossip-learned shard_route (strictly-newer epochs win)
+            # takes precedence in DCReplica._route, so live shard moves
+            # re-point catch-up without touching this
+            fids = by_dc.get(origin)
+            if not fids:
+                return origin
+            return fids[shard % len(fids)]
+
+        self.route_query = route
+        connect = getattr(self.hub, "connect_remote", None)
+        for fid, d in fleet.items():
+            if d.address is not None and connect is not None:
+                connect(fid, d.address[0], int(d.address[1]))
         mode = self.bootstrap()
-        self.hub.subscribe(self.fabric_id, self.owner_fid,
-                           self._on_message)
+        for fid in fleet:
+            self.hub.subscribe(self.fabric_id, fid, self._on_message)
         with self._boot_lock:
             self._in_heal = True
             try:
@@ -201,9 +260,10 @@ class FollowerReplica(DCReplica):
                     self.last_seen)
                 mode = "tail"
                 if not have_local:
-                    meta = self._owner_image_meta()
-                    if meta is not None:
-                        self._reinstall(meta)
+                    metas = {fid: self._owner_image_meta(fid=fid)
+                             for fid in self._image_fids()}
+                    if any(m is not None for m in metas.values()):
+                        self._reinstall(metas)
                         mode = "image"
                 # a position below the owner's floor (long-partitioned /
                 # blank-WAL follower — or the floor advancing again
@@ -303,23 +363,32 @@ class FollowerReplica(DCReplica):
                     self.last_seen[key] = n
 
     # -- image shipping --------------------------------------------------
-    def _owner_image_meta(self, before_id: Optional[int] = None
-                          ) -> Optional[dict]:
-        body = {} if before_id is None else {"before_id": int(before_id)}
-        return self.hub.request(self.owner_fid, "ckpt_meta", body)
+    def _image_fids(self) -> List[int]:
+        """Fabric ids to source checkpoint images from: every owner-DC
+        member (their images compose the whole DC store); the single-
+        member owner degenerates to ``[owner_fid]``."""
+        return list(self.member_fids) or [self.owner_fid]
 
-    def _fetch_image(self, meta: dict) -> dict:
-        """Ship the owner's image in chunks over the request channel and
-        verify size + CRC before decoding — a truncated or bit-rotted
-        ship must fail loudly, never install."""
+    def _owner_image_meta(self, before_id: Optional[int] = None,
+                          fid: Optional[int] = None) -> Optional[dict]:
+        body = {} if before_id is None else {"before_id": int(before_id)}
+        return self.hub.request(self.owner_fid if fid is None else fid,
+                                "ckpt_meta", body)
+
+    def _fetch_image(self, meta: dict,
+                     fid: Optional[int] = None) -> dict:
+        """Ship one member's image in chunks over the request channel
+        and verify size + CRC before decoding — a truncated or
+        bit-rotted ship must fail loudly, never install."""
         import zlib
 
         from antidote_tpu.store.handoff import unpack
 
+        fid = self.owner_fid if fid is None else fid
         size = int(meta["image_bytes"])
         buf = bytearray()
         while len(buf) < size:
-            r = self.hub.request(self.owner_fid, "ckpt_fetch", {
+            r = self.hub.request(fid, "ckpt_fetch", {
                 "id": int(meta["id"]), "off": len(buf),
                 "n": DCReplica.CKPT_SHIP_CHUNK,
             })
@@ -339,8 +408,45 @@ class FollowerReplica(DCReplica):
             )
         return unpack(data)
 
-    def _reinstall(self, meta: Optional[dict] = None) -> None:
-        """Discard local state and install the owner's newest image.
+    def _fetch_member_image(self, fid: int, meta: Optional[dict] = None):
+        """Resolve + fetch one member's newest verifiable image with the
+        bit-rot/retirement fallback: a failed fetch prefers the next
+        OLDER retained image (owner-side recovery's discipline), else
+        re-resolves the newest (a fresh one may have published
+        mid-ship).  Returns ``(image, meta)`` or ``(None, None)`` when
+        the member has nothing published (its shards then bootstrap via
+        whole-chain WAL catch-up — a member without an image has never
+        compacted, so its full chain is servable)."""
+        last: Optional[BaseException] = None
+        for _attempt in range(3):
+            if meta is None:
+                meta = self._owner_image_meta(fid=fid)
+            if meta is None:
+                return None, None
+            try:
+                return self._fetch_image(meta, fid=fid), meta
+            except (RuntimeError, OSError) as e:
+                log.warning("follower %s: image ckpt_%s fetch from "
+                            "endpoint %d failed (%s); falling back to "
+                            "an older retained image (else re-resolving "
+                            "the newest)", self.name, meta.get("id"),
+                            fid, e)
+                last = e
+                try:
+                    meta = self._owner_image_meta(
+                        before_id=meta.get("id"), fid=fid)
+                except Exception:
+                    meta = None
+        raise RuntimeError(
+            "checkpoint image shipping failed repeatedly"
+        ) from last
+
+    def _reinstall(self, metas: Optional[Dict[int, dict]] = None) -> None:
+        """Discard local state and install the owner fleet's newest
+        images — one per member, each restricted to the shards that
+        member currently owns (``install_image(shards=...)``), composing
+        the whole DC store; the single-member owner installs one
+        unrestricted image exactly as before.
 
         The store is REPLACED (fresh tables, same LogManager): the old
         device state may be arbitrarily wrong (that's why we're here),
@@ -350,50 +456,31 @@ class FollowerReplica(DCReplica):
         checkpoint so the follower's own crash recovery covers the
         installed prefix (its WAL only ever holds the tail).
 
-        ``meta``: an already-resolved ``ckpt_meta`` reply (bootstrap
-        passes the one it decided on, saving a round trip).  The fetch
-        RETRIES against freshly-resolved metadata: the owner's
-        retention sweep can retire the image we were shipping mid-fetch
-        (FileNotFoundError / short read at the owner), and the cure is
-        simply the newer image."""
+        ``metas``: already-resolved ``ckpt_meta`` replies by fabric id
+        (bootstrap passes the ones it decided on, saving round trips).
+        Every image is fetched BEFORE the store wipe, so a mid-fetch
+        failure (owner unreachable, image retired, verification
+        failure) leaves the local state untouched."""
         from antidote_tpu.log import checkpoint as _ckpt
         from antidote_tpu.log.checkpoint import install_image
 
-        image = None
-        last: Optional[BaseException] = None
-        for _attempt in range(3):
-            if meta is None:
-                meta = self._owner_image_meta()
-            if meta is None:
-                raise RuntimeError(
-                    "owner has no published checkpoint image to "
-                    "bootstrap from (run checkpoint-now on the owner, "
-                    "or size its --checkpoint-interval-s below the "
-                    "follower's outage)"
-                )
-            try:
-                image = self._fetch_image(meta)
-                break
-            except (RuntimeError, OSError) as e:
-                log.warning("follower %s: image ckpt_%s fetch failed "
-                            "(%s); falling back to an older retained "
-                            "image (else re-resolving the newest)",
-                            self.name, meta.get("id"), e)
-                last = e
-                # the newest image may be corrupt on the owner's disk
-                # (bit rot — the same case owner-side recovery falls
-                # back for) or retired mid-ship: prefer the next OLDER
-                # retained image, else re-resolve the newest (a fresh
-                # one may have published meanwhile)
-                try:
-                    meta = self._owner_image_meta(
-                        before_id=meta.get("id"))
-                except Exception:
-                    meta = None
-        if image is None:
+        fids = self._image_fids()
+        multi = len(fids) > 1
+        images: List[tuple] = []  # (image, restrict-shards or None)
+        for fid in fids:
+            meta = (metas or {}).get(fid)
+            image, meta = self._fetch_member_image(fid, meta)
+            if image is None:
+                continue
+            images.append((image,
+                           meta.get("shards") if multi else None))
+        if not images:
             raise RuntimeError(
-                "checkpoint image shipping failed repeatedly"
-            ) from last
+                "owner has no published checkpoint image to "
+                "bootstrap from (run checkpoint-now on the owner, "
+                "or size its --checkpoint-interval-s below the "
+                "follower's outage)"
+            )
         node, txm = self.node, self.node.txm
         cfg = node.cfg
         with txm.commit_lock:
@@ -406,11 +493,16 @@ class FollowerReplica(DCReplica):
                 logm.truncate_shard(shard)
             # adopt the OWNER's truncation epochs: ours were just bumped
             # by the truncations above, and install_image would drop
-            # every imaged shard as stale against them
-            logm.adopt_shard_resets({
-                int(k): int(v)
-                for k, v in (image.get("shard_resets") or {}).items()
-            })
+            # every imaged shard as stale against them.  Per-member
+            # images contribute exactly their restricted shards' epochs.
+            resets: Dict[int, int] = {}
+            for image, restrict in images:
+                allowed = (None if restrict is None
+                           else {int(s) for s in restrict})
+                for k, v in (image.get("shard_resets") or {}).items():
+                    if allowed is None or int(k) in allowed:
+                        resets[int(k)] = int(v)
+            logm.adopt_shard_resets(resets)
             store = KVStore(cfg, sharding=old.sharding, log=logm)
             store.metrics = getattr(node, "metrics", None)
             if old.mesh is not None:
@@ -430,7 +522,8 @@ class FollowerReplica(DCReplica):
             txm.committed_keys = {}
             txm.commit_counter = 0
             txm.epoch_lag_counter = 0
-            install_image(store, txm, image)
+            for image, restrict in images:
+                install_image(store, txm, image, shards=restrict)
             # follower floor fixup: the install stamped the OWNER's WAL
             # floors/seqs, but this WAL is freshly truncated — local
             # appends must mint q from 1 and local replay must skip
@@ -468,12 +561,18 @@ class FollowerReplica(DCReplica):
 
     # -- chain catch-up ---------------------------------------------------
     def _catch_up_all(self) -> None:
-        """Pull every shard's own-origin chain suffix from the owner —
+        """Pull every subscribed chain's suffix — the owner DC's
+        own-origin chains (routed per shard to the owning member via
+        the gossip-learned routes, modular fallback before any gossip)
+        plus, for geo owners, every subscribed peer DC's chains —
         bootstrap's bulk path and the subscribe-window closer; steady
         state uses the ordinary ping-revealed gap machinery."""
+        origins = (sorted(self.fleet_by_dc) if self.fleet_by_dc
+                   else [self.dc_id])
         for shard in sorted(self.shards):
-            key = (self.dc_id, shard)
-            super()._catch_up(key, self.last_seen.get(key, 0))
+            for origin in origins:
+                key = (origin, shard)
+                super()._catch_up(key, self.last_seen.get(key, 0))
         # the replayed suffix sits in the causal gate: drain it NOW (the
         # steady-state drain runs on stream deliveries, which a replica
         # mid-bootstrap/heal has none of) — _drain_gates also republishes
@@ -542,24 +641,41 @@ class FollowerReplica(DCReplica):
         return txm._publish_serving_epoch_locked()
 
     # -- session gate ------------------------------------------------------
-    def gate_read(self, objects, clock, deadline: Optional[float] = None
-                  ) -> None:
+    def _gate_refused(self, msg: str, dialect: str,
+                      floor_ms: int = 0) -> "ReplicaLagging":
+        """Build one typed lagging redirect: counts the refusal, bumps
+        the streak, and scales the retry hint with it (25..500 ms, the
+        AdmissionGate discipline) — a parked fleet backs off harder the
+        longer this replica has refused every read since its last
+        admission, instead of hammering on a fixed hint."""
+        from antidote_tpu.overload import ReplicaLagging, retry_hint_ms
+
+        self._gate_streak += 1
+        m = getattr(self.node, "metrics", None)
+        if m is not None:
+            m.session_redirects.inc(kind="lagging", dialect=dialect)
+        return ReplicaLagging(
+            msg, retry_after_ms=max(floor_ms,
+                                    retry_hint_ms(self._gate_streak)),
+            redirect=self.owner_client_addr,
+        )
+
+    def gate_read(self, objects, clock, deadline: Optional[float] = None,
+                  dialect: str = "native") -> None:
         """Admission gate for session reads on this follower: park until
         the PER-SHARD applied clocks of every shard the read touches
         cover the token, then make sure the serving epoch cannot claim
         coverage it lacks; past the park window (or while not serving)
-        answer a typed redirect instead — never a stale read."""
-        from antidote_tpu.overload import ReplicaLagging
-
-        m = getattr(self.node, "metrics", None)
+        answer a typed redirect instead — never a stale read.  Both wire
+        dialects route here (``dialect`` labels the redirect metric);
+        retry hints scale with the refusal streak since the last
+        admitted read."""
         if self.state != "serving":
-            if m is not None:
-                m.session_redirects.inc(kind="lagging")
-            raise ReplicaLagging(
-                f"follower {self.name} is {self.state}",
-                retry_after_ms=250, redirect=self.owner_client_addr,
-            )
+            raise self._gate_refused(
+                f"follower {self.name} is {self.state}", dialect,
+                floor_ms=250)
         if clock is None:
+            self._gate_streak = 0
             return
         cfg = self.node.cfg
         vec = np.zeros(cfg.max_dcs, np.int64)
@@ -577,21 +693,19 @@ class FollowerReplica(DCReplica):
             if self.state != "serving":
                 break
             if all((store.applied_vc[s] >= vec).all() for s in shards):
-                self._ensure_epoch_covers(store, shards, vec)
+                self._ensure_epoch_covers(store, shards, vec, dialect)
+                self._gate_streak = 0
                 return
             if time.monotonic() >= end:
                 break
             time.sleep(0.002)
-        if m is not None:
-            m.session_redirects.inc(kind="lagging")
-        raise ReplicaLagging(
+        raise self._gate_refused(
             f"follower {self.name} applied clock is behind the session "
-            f"token after a {int(self.park_s * 1e3)} ms park",
-            retry_after_ms=50, redirect=self.owner_client_addr,
-        )
+            f"token after a {int(self.park_s * 1e3)} ms park", dialect)
 
     def _ensure_epoch_covers(self, store, shards: List[int],
-                             vec: np.ndarray) -> None:
+                             vec: np.ndarray,
+                             dialect: str = "native") -> None:
         """The epoch-plane half of the gate: the live applied clocks
         cover the token, but the FROZEN serving epoch may predate the
         covering applies while its (cross-shard max) VC still claims the
@@ -600,8 +714,6 @@ class FollowerReplica(DCReplica):
         current epoch would claim the token without covering it on the
         target shards, publish a fresh one (which captures the live,
         covering cut)."""
-        from antidote_tpu.overload import ReplicaLagging
-
         for _attempt in range(2):
             ep = store.serving_epoch
             if ep is None:
@@ -613,31 +725,30 @@ class FollowerReplica(DCReplica):
                 return
             with self.node.txm.commit_lock:
                 self.publish_applied_epoch_locked()
-        m = getattr(self.node, "metrics", None)
-        if m is not None:
-            m.session_redirects.inc(kind="lagging")
-        raise ReplicaLagging(
+        raise self._gate_refused(
             f"follower {self.name} could not refresh its serving epoch "
-            "to cover the session token (publish deferred)",
-            retry_after_ms=50, redirect=self.owner_client_addr,
-        )
+            "to cover the session token (publish deferred)", dialect)
 
     # -- divergence detection ---------------------------------------------
     def check_divergence(self, shards=None) -> Dict[int, str]:
         """Compare per-shard content digests against the owner at EQUAL
-        applied clocks.  ``skipped`` = clocks unequal (replication in
-        flight — nothing comparable, retried next sweep); ``ok`` =
-        digests match; ``mismatch`` = silent corruption — the follower
-        quarantines itself and re-bootstraps from the owner's image
-        before serving another session read."""
+        applied clocks — each shard against WHICHEVER member owns it at
+        the compared clock (the gossip-learned route; a mid-fleet shard
+        move re-points the comparison with no reconnect).  ``skipped`` =
+        clocks unequal (replication in flight — nothing comparable,
+        retried next sweep); ``ok`` = digests match; ``mismatch`` =
+        silent corruption — the follower quarantines itself and
+        re-bootstraps from the fleet's images before serving another
+        session read."""
         m = getattr(self.node, "metrics", None)
         out: Dict[int, str] = {}
         for shard in (range(self.node.cfg.n_shards)
                       if shards is None else shards):
             shard = int(shard)
             try:
-                reply = self.hub.request(self.owner_fid, "shard_digest",
-                                         {"shard": shard})
+                reply = self.hub.request(
+                    self._route(self.dc_id, shard), "shard_digest",
+                    {"shard": shard})
             except Exception as e:
                 log.warning("follower %s: divergence check for shard %d "
                             "unreachable (%r)", self.name, shard, e)
@@ -673,18 +784,26 @@ class FollowerReplica(DCReplica):
     def _send_report(self) -> None:
         if self.owner_fid is None:
             return
-        try:
-            self.hub.request(self.owner_fid, "follower_report", {
-                "name": self.name,
-                "applied": [int(x) for x in self.node.store.dc_max_vc()],
-                "addr": (list(self.client_addr)
-                         if getattr(self, "client_addr", None) else None),
-                "state": self.state,
-                "boots": self.boots,
-            })
-        except Exception:
-            # the owner is unreachable (partition / restart): the
-            # subscription reconnect machinery owns the healing; the
+        body = {
+            "name": self.name,
+            "applied": [int(x) for x in self.node.store.dc_max_vc()],
+            "addr": (list(self.client_addr)
+                     if getattr(self, "client_addr", None) else None),
+            "state": self.state,
+            "boots": self.boots,
+        }
+        failed = 0
+        # every owner-DC member keeps a registry, so replica-status
+        # answers (and fleet-aware consoles work) against any of them
+        fids = self.member_fids or [self.owner_fid]
+        for fid in fids:
+            try:
+                self.hub.request(fid, "follower_report", body)
+            except Exception:
+                failed += 1
+        if failed == len(fids):
+            # the whole owner DC is unreachable (partition / restart):
+            # the subscription reconnect machinery owns the healing; the
             # owner meanwhile marks this follower DOWN by report age
             now = time.monotonic()
             if now - getattr(self, "_last_report_warn", 0.0) > 5.0:
@@ -703,6 +822,11 @@ class FollowerReplica(DCReplica):
             "boots": self.boots,
             "last_bootstrap_mode": self.last_bootstrap_mode,
             "divergence": dict(self.divergence_counts),
+            "fleet": {
+                "owner_members": max(1, len(self.member_fids)),
+                "peer_dcs": sorted(d for d in self.fleet_by_dc
+                                   if d != self.dc_id),
+            },
         }
 
     def replica_admin(self, body: dict) -> dict:

@@ -677,14 +677,20 @@ class DCReplica:
         None (no durable log / nothing published yet — the follower
         falls back to a whole-chain WAL catch-up).  ``before_id`` in the
         payload restricts to strictly older retained images (follower
-        fallback past a corrupt newest)."""
+        fallback past a corrupt newest).  The reply carries this
+        endpoint's CURRENTLY owned shard set: a follower composing a
+        clustered owner's store installs each member's image restricted
+        to exactly those shards (ISSUE 11)."""
         from antidote_tpu.log import checkpoint as _ckpt
 
         wlog = self.node.store.log
         if wlog is None:
             return None
         before = (payload or {}).get("before_id")
-        return _ckpt.latest_image_meta(wlog.dir, before_id=before)
+        meta = _ckpt.latest_image_meta(wlog.dir, before_id=before)
+        if meta is not None:
+            meta["shards"] = sorted(int(s) for s in self.shards)
+        return meta
 
     def _serve_ckpt_fetch(self, payload) -> dict:
         """One chunk of a published image (``{id, off, n}`` ->
@@ -745,7 +751,10 @@ class DCReplica:
             ent["state"] = payload.get("state", "serving")
             ent["boots"] = int(payload.get("boots", ent.get("boots", 0)))
             ent["at"] = time.monotonic()
+            n_followers = len(self.followers)
         m = getattr(self.node, "metrics", None)
+        if m is not None:
+            m.fleet_followers.set(n_followers)
         if m is not None and len(ent["applied"]) > self.dc_id:
             lag = max(0, int(self.node.txm.commit_counter)
                       - int(ent["applied"][self.dc_id]))

@@ -199,7 +199,7 @@ def discard_all(log_dir: str) -> int:
 # ---------------------------------------------------------------------------
 # image install (recovery side)
 # ---------------------------------------------------------------------------
-def install_image(store, txm, image: dict) -> dict:
+def install_image(store, txm, image: dict, shards=None) -> dict:
     """Install a checkpoint image into a FRESH store/txn-manager pair
     (the recovery fast path's first phase; the caller replays the WAL
     tail afterwards — :meth:`LogManager.replay_shard` already skips
@@ -210,6 +210,14 @@ def install_image(store, txm, image: dict) -> dict:
     the image's are DROPPED: a shard relinquished to another owner after
     the checkpoint was written must not resurrect here.  Returns a
     summary dict (keys, tables, dropped shards).
+
+    ``shards`` (optional) RESTRICTS the install to that shard set and
+    MERGES onto whatever the store already holds instead of replacing it
+    — the per-member composition primitive of the follower fleet tier
+    (ISSUE 11): a follower of a clustered owner installs each member's
+    image restricted to the shards that member owns, so one composed
+    store covers the whole DC.  Un-restricted installs keep the exact
+    whole-store replace semantics recovery depends on.
     """
     from antidote_tpu.store.kv import freeze_key
 
@@ -236,6 +244,11 @@ def install_image(store, txm, image: dict) -> dict:
         log.warning("checkpoint image predates truncation of shard(s) %s "
                     "(moved/relinquished after the stamp); dropping them "
                     "from the restore", stale)
+    #: restricted-merge mode: the shard rows this install may touch
+    #: (sorted list), or None for the whole-store replace
+    rlist = None
+    if shards is not None:
+        rlist = sorted(set(int(s) for s in shards) - stale_set)
     floors = np.asarray(image["floor_seqs"], np.int64).copy()
     chains = np.asarray(image["chain_floor"], np.int64).copy()
     op_ids = np.asarray(image["op_ids"], np.int64).copy()
@@ -282,11 +295,20 @@ def install_image(store, txm, image: dict) -> dict:
             return out
 
         def full(dst, src, snap_slot=False):
-            arr = np.zeros(dst.shape, np.dtype(dst.dtype))
-            if snap_slot:
-                arr[:, :u_cap, 0] = src
+            if rlist is None:
+                arr = np.zeros(dst.shape, np.dtype(dst.dtype))
+                if snap_slot:
+                    arr[:, :u_cap, 0] = src
+                else:
+                    arr[:, :u_cap] = src
             else:
-                arr[:, :u_cap] = src
+                # restricted merge: keep the destination's other shards
+                # (a previous member's installed rows) byte-intact
+                arr = np.array(dst, dtype=np.dtype(dst.dtype))
+                if snap_slot:
+                    arr[rlist, :u_cap, 0] = src[rlist]
+                else:
+                    arr[rlist, :u_cap] = src[rlist]
             return place(arr)
 
         for f in t.head:
@@ -303,22 +325,38 @@ def install_image(store, txm, image: dict) -> dict:
                    < used[:, None]).astype(np.int64)
         t.snap_seq = full(t.snap_seq, seq_col, snap_slot=True)
         t.next_seq = 2
-        t.used_rows[:] = used
-        t.slots_ub[:, :u_cap] = slots_ub
-        t.max_abs_delta = int(tb["max_abs_delta"])
-        if stale:
+        if rlist is None:
+            t.used_rows[:] = used
+            t.slots_ub[:, :u_cap] = slots_ub
+            t.max_abs_delta = int(tb["max_abs_delta"])
+        else:
+            t.used_rows[rlist] = used[rlist]
+            t.slots_ub[rlist, :u_cap] = slots_ub[rlist]
+            t.max_abs_delta = max(t.max_abs_delta,
+                                  int(tb["max_abs_delta"]))
+        if stale or rlist is not None:
             # a dropped shard may have held the table-wide max commit VC;
             # an inflated cap would let a serving epoch claim coverage of
             # commits that never restored — recompute from survivors
-            mcv = head_vc.reshape(-1, head_vc.shape[-1]).max(axis=0) \
-                if head_vc.size else np.zeros(cfg.max_dcs, np.int32)
+            # (restricted merges fold the installed rows into whatever
+            # cap earlier members established)
+            hv = head_vc if rlist is None else head_vc[rlist]
+            mcv = hv.reshape(-1, head_vc.shape[-1]).max(axis=0) \
+                if hv.size else np.zeros(cfg.max_dcs, np.int32)
+            if rlist is not None:
+                mcv = np.maximum(mcv, np.asarray(t.max_commit_vc,
+                                                 np.int32))
             t.max_commit_vc = mcv.astype(np.int32)
         else:
             t.max_commit_vc = np.asarray(tb["max_commit_vc"],
                                          np.int32).copy()
-        n_rows_installed += int(used.sum())
+        n_rows_installed += int(used.sum() if rlist is None
+                                else used[rlist].sum())
     directory = image["directory"]
-    if stale_set:
+    if rlist is not None:
+        keep = set(rlist)
+        directory = [e for e in directory if int(e[3]) in keep]
+    elif stale_set:
         directory = [e for e in directory if int(e[3]) not in stale_set]
     n_keys = len(directory)
     if directory:
@@ -333,13 +371,28 @@ def install_image(store, txm, image: dict) -> dict:
     for h, data in image.get("blobs", []):
         store.blobs.intern_bytes(int(h), bytes(data))
     for s, hashes in enumerate(image.get("blob_seen", [])):
-        if s < cfg.n_shards and s not in stale_set:
+        if s < cfg.n_shards and s not in stale_set \
+                and (rlist is None or s in set(rlist)):
             logm._blob_seen[s] = {int(h) for h in hashes}
-    np.maximum(store.applied_vc, stamp, out=store.applied_vc)
-    np.maximum(logm.op_ids, op_ids, out=logm.op_ids)
-    logm.set_floor(floors, chains)
+    if rlist is None:
+        np.maximum(store.applied_vc, stamp, out=store.applied_vc)
+        np.maximum(logm.op_ids, op_ids, out=logm.op_ids)
+        logm.set_floor(floors, chains)
+    else:
+        # merge only the restricted rows — other members' floors/clocks
+        # must survive this install untouched
+        store.applied_vc[rlist] = np.maximum(store.applied_vc[rlist],
+                                             stamp[rlist])
+        logm.op_ids[rlist] = np.maximum(logm.op_ids[rlist],
+                                        op_ids[rlist])
+        fl = logm.floor_seqs.copy()
+        ch = logm.chain_floor.copy()
+        fl[rlist] = floors[rlist]
+        ch[rlist] = chains[rlist]
+        logm.set_floor(fl, ch)
     committed = image.get("committed_keys", [])
-    if committed and not stale_set and not txm.committed_keys:
+    if committed and not stale_set and rlist is None \
+            and not txm.committed_keys:
         # fresh manager, nothing dropped: bulk build (the per-entry
         # max/membership checks below cost ~1 s per million stamps)
         ck, cb, cv = zip(*committed)
@@ -359,6 +412,7 @@ def install_image(store, txm, image: dict) -> dict:
         "rows": n_rows_installed,
         "tables": len(image["tables"]),
         "dropped_shards": stale,
+        "restricted_to": rlist,
     }
 
 

@@ -543,8 +543,14 @@ class NodeMetrics:
             "antidote_session_redirects_total",
             "Session requests a replica refused with a typed redirect "
             "(lagging = applied clock behind the token after the park "
-            "window; not_owner = write/txn sent to a follower)",
-            ("kind",),
+            "window; not_owner = write/txn sent to a follower), by wire "
+            "dialect (native msgpack | apb protobuf)",
+            ("kind", "dialect"),
+        )
+        self.fleet_followers = r.gauge(
+            "antidote_fleet_followers",
+            "Followers currently registered with this owner's replica "
+            "registry (the fleet the hash ring routes over)",
         )
         self.follower_bootstrap = r.counter(
             "antidote_follower_bootstrap_total",

@@ -87,6 +87,17 @@ class ReplicaDown(ConnectionError):
     client's failover loop."""
 
 
+def retry_hint_ms(streak: int) -> int:
+    """Pressure-scaled retry hint shared by every refusal plane: the
+    streak counts refusals since the plane last admitted work, so it
+    measures how deep the overload (or replication lag) runs — back off
+    harder the longer the plane has stayed saturated, bounded 25..500 ms
+    (the AdmissionGate discipline, PR 4; the follower session gate
+    reuses it so a parked fleet stops hammering a lagging replica with a
+    fixed hint)."""
+    return max(25, min(500, 25 * (1 + int(streak) // 4)))
+
+
 def deadline_from_ms(deadline_ms, default_ms=None) -> Optional[float]:
     """Absolute monotonic deadline from a client-supplied relative ms
     budget (``None`` falls back to the configured default, which may
@@ -170,9 +181,10 @@ class AdmissionGate:
         # harder the longer the pool has stayed full (bounded
         # 25..500 ms)
         self._shed_streak += 1
-        return max(25, min(500, 25 * (1 + self._shed_streak // 4)))
+        return retry_hint_ms(self._shed_streak)
 
 
 __all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
            "NotOwnerError", "ReplicaLagging", "ReplicaDown",
-           "AdmissionGate", "deadline_from_ms", "check_deadline"]
+           "AdmissionGate", "deadline_from_ms", "check_deadline",
+           "retry_hint_ms"]

@@ -23,6 +23,7 @@ dispatches per-frame on the code byte (proto/server.py).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
@@ -413,30 +414,190 @@ def value_to_read_resp(type_name: str, value) -> Dict[str, Any]:
     raise ValueError(f"no apb value lane for {type_name}")
 
 
+def read_resp_to_value(resp: Dict[str, Any]):
+    """Client-side inverse of :func:`value_to_read_resp`: one decoded
+    ApbReadObjectResp -> the client-visible value (counter int, set
+    bytes list, register bytes, flag bool, map dict) — what an
+    apb-dialect session client folds into its loop."""
+    if resp.get("counter") is not None:
+        return int(resp["counter"]["value"])
+    if resp.get("set") is not None:
+        return list(resp["set"].get("value", []))
+    if resp.get("reg") is not None:
+        return resp["reg"]["value"]
+    if resp.get("mvreg") is not None:
+        return list(resp["mvreg"].get("values", []))
+    if resp.get("flag") is not None:
+        return bool(resp["flag"]["value"])
+    if resp.get("map") is not None:
+        out = {}
+        for ent in resp["map"].get("entries", []):
+            k = ent["key"]
+            out[(k["key"], CRDT_TYPES[k["type"]])] = read_resp_to_value(
+                ent["value"])
+        return out
+    return None
+
+
+def _op_to_operation(type_name: str, op: tuple) -> Dict[str, Any]:
+    """One native op tuple -> ApbUpdateOperation (client-side inverse of
+    :func:`ops_from_update_operation` for the wire-expressible ops)."""
+    kind, arg = op[0], (op[1] if len(op) > 1 else None)
+    if type_name in ("map_rr", "map_go"):
+        # map ops ride the mapop lane — the generic branches below
+        # would mis-encode a field tuple as a set payload
+        if kind == "update":
+            fields = list(arg) if isinstance(arg, (list, tuple)) \
+                and arg and isinstance(arg[0], (list, tuple)) \
+                and len(arg[0]) == 2 and isinstance(
+                    arg[0][0], (list, tuple)) else [arg]
+            return {"mapop": {"updates": [
+                {"key": {"key": to_bytes(fk), "type": TYPE_IDS[ft]},
+                 "update": _op_to_operation(ft, sub)}
+                for (fk, ft), sub in fields
+            ]}}
+        if kind in ("remove", "remove_all"):
+            fields = [arg] if kind == "remove" else list(arg)
+            return {"mapop": {"removedKeys": [
+                {"key": to_bytes(fk), "type": TYPE_IDS[ft]}
+                for fk, ft in fields
+            ]}}
+        if kind == "reset":
+            return {"resetop": {}}
+        raise ValueError(f"map op {kind!r} has no apb wire form")
+    if kind in ("increment", "decrement"):
+        amt = arg if not isinstance(arg, (tuple, list)) else arg[0]
+        amt = 1 if amt is None else int(amt)
+        return {"counterop": {"inc": amt if kind == "increment"
+                              else -amt}}
+    if kind in ("add", "add_all", "remove", "remove_all"):
+        vals = (list(arg) if kind.endswith("_all")
+                else [arg])
+        field = "adds" if kind.startswith("add") else "rems"
+        return {"setop": {"optype": _SET_ADD if field == "adds"
+                          else _SET_REMOVE,
+                          field: [to_bytes(v) for v in vals]}}
+    if kind == "assign":
+        return {"regop": {"value": to_bytes(arg)}}
+    if kind in ("enable", "disable"):
+        return {"flagop": {"value": kind == "enable"}}
+    if kind == "reset":
+        return {"resetop": {}}
+    raise ValueError(f"op {kind!r} has no apb wire form")
+
+
+def update_op_from_native(update: tuple) -> Dict[str, Any]:
+    """One native update tuple ``(key, type, bucket, op)`` ->
+    ApbUpdateOp — what an apb-dialect session client sends for its
+    writes."""
+    key, t, bucket, op = update
+    return {
+        "boundobject": {"key": to_bytes(key), "type": TYPE_IDS[t],
+                        "bucket": to_bytes(bucket)},
+        "operation": _op_to_operation(t, op),
+    }
+
+
 def _error(msg: str) -> bytes:
     return encode_frame_body("ApbErrorResp", {
         "errmsg": to_bytes(msg), "errcode": 0,
     })
 
 
-def _overload_text(e) -> str:
-    """Typed overload error text: proto2 ApbErrorResp has no structured
-    retry field, so the kind + retry-after hint ride the errmsg prefix
-    ("busy retry_after_ms=NN: ..."), which antidotec_pb clients surface
-    verbatim."""
-    from antidote_tpu.overload import BusyError, DeadlineExceeded
+def error_text(kind: str, msg: str, retry_after_ms: int = 0,
+               redirect=None) -> str:
+    """Typed error text: proto2 ApbErrorResp has no structured retry or
+    redirect field, so the kind + retry-after hint + owner redirect ride
+    the errmsg prefix (``"lagging retry_after_ms=NN
+    redirect=HOST:PORT: ..."``), which antidotec_pb clients surface
+    verbatim and session-aware ones parse back with
+    :func:`parse_error_text` — the apb twin of the native dialect's
+    structured error fields (ISSUE 11)."""
+    out = kind
+    if retry_after_ms:
+        out += f" retry_after_ms={int(retry_after_ms)}"
+    if redirect:
+        out += f" redirect={redirect[0]}:{int(redirect[1])}"
+    return f"{out}: {msg}"
 
-    if isinstance(e, BusyError):
-        return f"busy retry_after_ms={int(e.retry_after_ms)}: {e}"
-    if isinstance(e, DeadlineExceeded):
-        return f"deadline: {e}"
-    return f"read_only: {e}"
+
+#: "kind key=val key=val: detail" — values are space-free (the redirect
+#: value's own colon is fine: the detail separator is colon+SPACE)
+_ERR_RE = re.compile(r"^([a-z_]+)((?: [a-z_]+=\S+)*): (.*)$", re.DOTALL)
+
+
+def parse_error_text(errmsg) -> Dict[str, Any]:
+    """Inverse of :func:`error_text`: decode an ApbErrorResp errmsg into
+    ``{kind, retry_after_ms, redirect, detail}``.  Unrecognized shapes
+    come back as ``kind="error"`` with the whole text as detail, so a
+    plain reference-server error never crashes a session client."""
+    text = errmsg.decode("utf-8", "replace") \
+        if isinstance(errmsg, (bytes, bytearray)) else str(errmsg)
+    m = _ERR_RE.match(text)
+    if m is None:
+        return {"kind": "error", "retry_after_ms": 0, "redirect": None,
+                "detail": text}
+    kind, params, detail = m.group(1), m.group(2), m.group(3)
+    out: Dict[str, Any] = {"kind": kind, "retry_after_ms": 0,
+                           "redirect": None, "detail": detail}
+    for part in params.split():
+        k, _, v = part.partition("=")
+        # a malformed value (a foreign server whose errmsg happens to
+        # match the prefix shape) falls back to the default, never a
+        # crash — the documented never-breaks-a-session contract
+        if k == "retry_after_ms":
+            try:
+                out["retry_after_ms"] = int(v)
+            except ValueError:
+                pass
+        elif k == "redirect":
+            host, _, port = v.rpartition(":")
+            try:
+                out["redirect"] = [host, int(port)]
+            except ValueError:
+                pass
+    return out
 
 
 def overload_error(kind: str, msg: str, retry_after_ms: int = 0) -> bytes:
     """Pre-dispatch overload reply frame (the server's admission shed)."""
-    hint = f" retry_after_ms={int(retry_after_ms)}" if retry_after_ms else ""
-    return _error(f"{kind}{hint}: {msg}")
+    return _error(error_text(kind, msg, retry_after_ms))
+
+
+def _error_resp(e) -> Tuple[str, Dict[str, Any]]:
+    """Map one exception to the typed ApbErrorResp reply — overload
+    sheds, follower session redirects (lagging/not_owner, carrying the
+    retry hint + owner redirect in the errmsg), and the reference's
+    catch-all shape for everything else."""
+    from antidote_tpu.overload import (BusyError, DeadlineExceeded,
+                                       NotOwnerError, ReadOnlyError,
+                                       ReplicaLagging)
+
+    if isinstance(e, BusyError):
+        text = error_text("busy", str(e), e.retry_after_ms)
+    elif isinstance(e, DeadlineExceeded):
+        text = error_text("deadline", str(e))
+    elif isinstance(e, ReadOnlyError):
+        text = error_text("read_only", str(e))
+    elif isinstance(e, ReplicaLagging):
+        text = error_text("lagging", str(e), e.retry_after_ms,
+                          e.redirect)
+    elif isinstance(e, NotOwnerError):
+        text = error_text("not_owner", str(e), redirect=e.redirect)
+    else:
+        text = f"{type(e).__name__}: {e}"
+    return "ApbErrorResp", {"errmsg": to_bytes(text), "errcode": 0}
+
+
+#: apb requests a FOLLOWER refuses with a typed not_owner redirect:
+#: writes and interactive transactions belong to the owner, and the DC
+#: mesh mutations would subscribe the follower to streams the owner
+#: never replicated (the native dialect's exact refusal set)
+FOLLOWER_REFUSED = frozenset((
+    "ApbStartTransaction", "ApbReadObjects", "ApbUpdateObjects",
+    "ApbCommitTransaction", "ApbStaticUpdateObjects",
+    "ApbConnectToDCs", "ApbCreateDC",
+))
 
 
 def handle_request(server, code: int, payload: bytes, conn_txns: set,
@@ -449,10 +610,24 @@ def handle_request(server, code: int, payload: bytes, conn_txns: set,
 
     ``lock`` (the server's dispatch lock) is held only around the
     node/_txns mutation — protobuf decode/encode run outside it, like the
-    native dialect."""
+    native dialect.
+
+    On a follower replica (``server.follower``) this dialect keeps the
+    native dialect's session discipline (ISSUE 11): static reads pass
+    the follower's token gate (in :func:`_dispatch_static`), and
+    writes/txns/DC mutations answer the typed not_owner redirect here —
+    errmsg-encoded, since proto2 ApbErrorResp has no structured fields."""
     import contextlib
 
     name = CODE_TO_NAME[code]
+    fol = getattr(server, "follower", None)
+    if fol is not None and name in FOLLOWER_REFUSED:
+        from antidote_tpu.overload import NotOwnerError
+
+        server.metrics.session_redirects.inc(kind="not_owner",
+                                             dialect="apb")
+        return encode_frame_body(
+            *_error_resp(NotOwnerError(fol.owner_client_addr)))
     try:
         req = decode_msg(name, payload)  # outside the lock
     except Exception as e:
@@ -490,6 +665,16 @@ def _dispatch_static(server, name: str, req: Dict[str, Any]):
             }
         clock = _dec_clock(req["transaction"].get("timestamp"))
         objs = [_bound_object(bo) for bo in req.get("objects", [])]
+        fol = getattr(server, "follower", None)
+        if fol is not None:
+            # the token gate — byte-for-byte the native dialect's
+            # session discipline: park for the applied clocks, then a
+            # typed lagging redirect (errmsg-encoded by _error_resp)
+            fol.gate_read(
+                objs,
+                None if clock is None else np.asarray(clock, np.int64),
+                deadline, dialect="apb",
+            )
         vals, vc = server.static_read(objs, clock, deadline=deadline)
         return "ApbStaticReadObjectsResp", {
             "objects": {
@@ -502,16 +687,7 @@ def _dispatch_static(server, name: str, req: Dict[str, Any]):
             "committime": {"success": True, "commit_time": _enc_clock(vc)},
         }
     except Exception as e:
-        from antidote_tpu.overload import (BusyError, DeadlineExceeded,
-                                           ReadOnlyError)
-
-        if isinstance(e, (BusyError, DeadlineExceeded, ReadOnlyError)):
-            return "ApbErrorResp", {
-                "errmsg": to_bytes(_overload_text(e)), "errcode": 0,
-            }
-        return "ApbErrorResp", {
-            "errmsg": to_bytes(f"{type(e).__name__}: {e}"), "errcode": 0,
-        }
+        return _error_resp(e)
 
 
 def _dispatch(server, name: str, req: Dict[str, Any],
@@ -616,13 +792,4 @@ def _dispatch(server, name: str, req: Dict[str, Any],
             "errmsg": to_bytes(f"unhandled apb request {name}"), "errcode": 0,
         }
     except Exception as e:  # mirror the reference's catch-all error reply
-        from antidote_tpu.overload import (BusyError, DeadlineExceeded,
-                                           ReadOnlyError)
-
-        if isinstance(e, (BusyError, DeadlineExceeded, ReadOnlyError)):
-            return "ApbErrorResp", {
-                "errmsg": to_bytes(_overload_text(e)), "errcode": 0,
-            }
-        return "ApbErrorResp", {
-            "errmsg": to_bytes(f"{type(e).__name__}: {e}"), "errcode": 0,
-        }
+        return _error_resp(e)

@@ -7,9 +7,13 @@ the same way: each request waits for its reply before the next —
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import socket
+import struct
 import threading
-from typing import Any, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import msgpack
 
@@ -232,6 +236,195 @@ class AntidoteClient:
         self._sock.close()
 
 
+def _h64(data: bytes) -> int:
+    """Stable 64-bit hash for ring placement (never Python's salted
+    ``hash``: every client must map a key to the same arc)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big")
+
+
+class HashRing:
+    """Consistent-hash ring over a follower fleet (ISSUE 11) — the
+    reference's riak_core chash ring role (SURVEY §1 L1,
+    ``log_utilities`` key→partition via ``chash_key``) applied to
+    REPLICA selection: keys map to a preferred follower through virtual
+    nodes, so adding/removing a follower remaps only its own arcs
+    (~1/N of the keyspace) instead of reshuffling everything, and a
+    fleet-wide client population agrees on the mapping with no
+    coordination.
+
+    The PLACEMENT hash is unseeded — every client must route a key to
+    the same preferred replica (that is what makes the fleet's snapshot
+    caches compose).  The FALLBACK order is seeded per client: when an
+    arc's owner dies, each client walks a differently-jittered order
+    over the survivors, so a fleet-wide follower death spreads across
+    the remaining fleet instead of stampeding every client onto the
+    same next endpoint (the satellite fix for PR 9's list-order
+    failover)."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 vnodes: int = 64, seed: int = 0):
+        self.vnodes = int(vnodes)
+        self.seed = int(seed)
+        self.endpoints: List[Tuple[str, int]] = [
+            (h, int(p)) for h, p in endpoints]
+        pts: List[Tuple[int, int]] = []
+        for i, (host, port) in enumerate(self.endpoints):
+            for v in range(self.vnodes):
+                pts.append((_h64(f"{host}:{port}#{v}".encode()), i))
+        pts.sort()
+        self._points = pts
+        self._hashes = [h for h, _ in pts]
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    def _key_hash(self, key, bucket) -> int:
+        return _h64(msgpack.packb([key, bucket], use_bin_type=True,
+                                  default=repr))
+
+    def preferred(self, key, bucket) -> Optional[Tuple[str, int]]:
+        """The key's arc owner (None on an empty ring)."""
+        if not self._points:
+            return None
+        kh = self._key_hash(key, bucket)
+        i = bisect.bisect_right(self._hashes, kh) % len(self._points)
+        return self.endpoints[self._points[i][1]]
+
+    def order(self, key, bucket) -> List[Tuple[str, int]]:
+        """Failover order for a key: the arc owner first (fleet-wide
+        agreement), then every other endpoint in this client's
+        deterministic seeded-jitter order (fleet-wide disagreement, on
+        purpose)."""
+        pref = self.preferred(key, bucket)
+        if pref is None:
+            return []
+        kh = self._key_hash(key, bucket)
+        tail = [ep for ep in self.endpoints if ep != pref]
+        tail.sort(key=lambda ep: _h64(
+            struct.pack(">QQ", self.seed & ((1 << 64) - 1), kh)
+            + f"{ep[0]}:{ep[1]}".encode()))
+        return [pref] + tail
+
+    def arc_share(self) -> Dict[Tuple[str, int], float]:
+        """Fraction of the hash space each endpoint owns (console/bench
+        observability: ring balance, and the fleet-smoke 'all arcs
+        served' gate)."""
+        if not self._points:
+            return {}
+        span = float(1 << 64)
+        out = {ep: 0.0 for ep in self.endpoints}
+        prev = self._points[-1][0] - (1 << 64)
+        for h, idx in self._points:
+            out[self.endpoints[idx]] += (h - prev) / span
+            prev = h
+        return out
+
+    def arc_share_by_name(self, digits: int = 4) -> Dict[str, float]:
+        """:meth:`arc_share` keyed ``"host:port"`` and rounded — the one
+        presentation every surface (console replica-status, session
+        stats, the bench artifact) shows."""
+        return {f"{h}:{p}": round(v, digits)
+                for (h, p), v in self.arc_share().items()}
+
+
+class ApbClient:
+    """Session-capable client speaking the antidote_pb protobuf dialect
+    (ISSUE 11): static reads/updates with the session token riding the
+    ApbStartTransaction timestamp, typed errors decoded from the errmsg
+    prefix (:func:`antidote_tpu.proto.apb.parse_error_text`) into the
+    SAME ``Remote*`` exceptions the native client raises — so
+    :class:`SessionClient` drives either dialect with one failover loop,
+    and protobuf clients get real read-your-writes failover instead of
+    a blanket refusal.  Carries the native client's at-most-once
+    tagging: transport failures are marked with whether the request
+    left the socket."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8087,
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._rfile = self._sock.makefile("rb")
+
+    def _call(self, name: str, body: Dict[str, Any]):
+        from antidote_tpu.proto import apb
+
+        frame = apb.encode_frame_body(name, body)
+        with self._lock:
+            try:
+                self._sock.sendall(struct.pack(">I", len(frame)) + frame)
+            except (ConnectionError, OSError) as e:
+                e.request_sent = False
+                raise
+            try:
+                data = read_frame_buffered(self._rfile)
+            except (ConnectionError, OSError) as e:
+                e.request_sent = True
+                raise
+        resp_name, resp = apb.decode_frame_body(data)
+        if resp_name == "ApbErrorResp":
+            err = apb.parse_error_text(resp.get("errmsg", b""))
+            kind, detail = err["kind"], err["detail"]
+            if kind == "busy":
+                raise RemoteBusy(detail, err["retry_after_ms"])
+            if kind == "deadline":
+                raise RemoteDeadline(detail)
+            if kind == "read_only":
+                raise RemoteReadOnly(detail)
+            if kind == "not_owner":
+                raise RemoteNotOwner(detail, redirect=err["redirect"])
+            if kind == "lagging":
+                raise RemoteLagging(detail, err["retry_after_ms"],
+                                    redirect=err["redirect"])
+            raise RemoteError(f"{kind}: {detail}")
+        return resp_name, resp
+
+    @staticmethod
+    def _txn_clock(clock) -> Dict[str, Any]:
+        if clock is None:
+            return {}
+        return {"timestamp": msgpack.packb([int(x) for x in clock])}
+
+    def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
+                     clock: Optional[Sequence[int]] = None,
+                     deadline_ms=None):
+        from antidote_tpu.proto import apb
+
+        name, resp = self._call("ApbStaticReadObjects", {
+            "transaction": self._txn_clock(clock),
+            "objects": [
+                {"key": apb.to_bytes(k), "type": apb.TYPE_IDS[t],
+                 "bucket": apb.to_bytes(b)}
+                for k, t, b in objects
+            ],
+        })
+        vals = [apb.read_resp_to_value(r)
+                for r in resp["objects"]["objects"]]
+        vc = msgpack.unpackb(resp["committime"]["commit_time"],
+                             raw=False)
+        return vals, vc
+
+    def update_objects(self, updates: Sequence[Tuple],
+                       clock: Optional[Sequence[int]] = None,
+                       deadline_ms=None) -> List[int]:
+        from antidote_tpu.proto import apb
+
+        name, resp = self._call("ApbStaticUpdateObjects", {
+            "transaction": self._txn_clock(clock),
+            "updates": [apb.update_op_from_native(u) for u in updates],
+        })
+        return msgpack.unpackb(resp["commit_time"], raw=False)
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+        self._sock.close()
+
+
 class SessionClient:
     """Causal session over an owner + follower fleet (ISSUE 9).
 
@@ -242,34 +435,96 @@ class SessionClient:
     **monotonic reads** hold no matter which replica serves, across
     arbitrary follower kills.
 
-    Routing: writes always go to the owner; reads stick to one follower
-    and fail over — to the next follower, and finally to the owner — on
-    a connection death or a typed ``lagging`` redirect (the follower's
-    applied clock hadn't caught the token inside its park window).  When
-    every endpoint fails, the typed
+    Routing (ISSUE 11): reads route over a consistent-hash ring
+    (:class:`HashRing`) across the follower fleet — each key has one
+    preferred replica fleet-wide (virtual-node arcs), failover walks a
+    per-client seeded-jittered order over the survivors, and the owner
+    is always the last resort — so a killed follower sheds only its
+    ring arcs, failover is one hop instead of an O(fleet) endpoint
+    walk, and a fleet-wide death never stampedes every client onto the
+    same next endpoint.  Writes always go to the owner.  Typed
+    ``lagging`` / ``not_owner`` redirects and connection deaths fail
+    over identically; when every endpoint fails, the typed
     :class:`~antidote_tpu.overload.ReplicaDown` surfaces.
+
+    The fleet can be passed statically (``followers``) or learned LIVE
+    from the owner's replica registry (``discover=True`` — the
+    ``replica-status`` surface; :meth:`refresh_fleet` re-learns it, and
+    a fully-failed read triggers one automatic re-learn before giving
+    up).  ``dialect`` selects the wire codec per endpoint: ``native``
+    (msgpack) or ``apb`` (antidote_pb protobuf) — both carry the same
+    token semantics and the same at-most-once write discipline.
     """
 
-    def __init__(self, owner, followers=(), timeout: float = 30.0):
+    #: a connection-dead endpoint is skipped for this long before being
+    #: retried (its ring arcs fail over; everyone else's are untouched)
+    DEAD_S = 2.0
+
+    def __init__(self, owner, followers=(), timeout: float = 30.0,
+                 dialect: str = "native", ring_vnodes: int = 64,
+                 seed: Optional[int] = None, discover: bool = False):
         self.owner = (owner[0], int(owner[1]))
-        self.followers = [(h, int(p)) for h, p in followers]
         self.timeout = timeout
+        if dialect not in ("native", "apb"):
+            raise ValueError(f"unknown dialect {dialect!r}")
+        self.dialect = dialect
+        self.ring_vnodes = int(ring_vnodes)
+        if seed is None:
+            import os as _os
+
+            seed = int.from_bytes(_os.urandom(8), "big")
+        self.seed = int(seed)
         #: the session token (None until the first clock is observed)
         self.token: Optional[List[int]] = None
         self._conns: dict = {}
-        self._ridx = 0
+        #: addr -> monotonic time until which it is skipped (conn death)
+        self._dead: Dict[Tuple[str, int], float] = {}
         #: session observability: typed lagging/not_owner redirects
-        #: honored, and endpoint failovers on connection death
+        #: honored, endpoint failovers on connection death, and reads
+        #: served per endpoint (the fleet-smoke arc coverage signal)
         self.redirects = 0
         self.failovers = 0
+        self.served_by: Dict[Tuple[str, int], int] = {}
+        self.followers: List[Tuple[str, int]] = []
+        self.ring = HashRing((), vnodes=self.ring_vnodes, seed=self.seed)
+        self._discover = bool(discover)
+        self._set_fleet(followers)
+        if self._discover and not self.followers:
+            self.refresh_fleet()
+
+    # -- fleet -----------------------------------------------------------
+    def _set_fleet(self, followers) -> None:
+        self.followers = [(h, int(p)) for h, p in followers]
+        self.ring = HashRing(self.followers, vnodes=self.ring_vnodes,
+                             seed=self.seed)
+
+    def refresh_fleet(self) -> List[Tuple[str, int]]:
+        """Re-learn the follower fleet from the owner's replica
+        registry: every follower the owner reports live-and-serving
+        (state ok/lagging — a lagging replica still serves most
+        sessions) with a known client address joins the ring.  The
+        registry op rides the native dialect (it is an ops surface,
+        served on the same port either way)."""
+        c = AntidoteClient(self.owner[0], self.owner[1],
+                           timeout=self.timeout)
+        try:
+            st = c.replica_admin("status")
+        finally:
+            c.close()
+        fleet = []
+        for _name, f in sorted((st.get("followers") or {}).items()):
+            if f.get("state") in ("ok", "lagging") and f.get("addr"):
+                fleet.append((f["addr"][0], int(f["addr"][1])))
+        self._set_fleet(fleet)
+        return self.followers
 
     # -- connections -----------------------------------------------------
-    def _conn(self, addr) -> AntidoteClient:
+    def _conn(self, addr):
         c = self._conns.get(addr)
         if c is None:
+            cls = AntidoteClient if self.dialect == "native" else ApbClient
             try:
-                c = AntidoteClient(addr[0], addr[1],
-                                   timeout=self.timeout)
+                c = cls(addr[0], addr[1], timeout=self.timeout)
             except (ConnectionError, OSError) as e:
                 # a DIAL failure never carried a request: tag it so the
                 # at-most-once write logic knows a retry is safe
@@ -331,26 +586,47 @@ class SessionClient:
             f"session write: owner {self.owner} unreachable"
         ) from last
 
-    def read_objects(self, objects: Sequence[Tuple[Any, str, str]]):
-        """Session read: current follower first, then the remaining
-        followers, then the owner.  The reply's snapshot clock folds
-        into the token (monotonic reads)."""
+    def _read_candidates(self, objects):
+        """Hash-ring failover order for a read, LAZILY: the first
+        object's key owns the routing decision (a multi-object session
+        read is one unit — splitting it across replicas would need
+        cross-replica snapshot agreement).  The healthy hot path pays
+        one key hash + bisect for the preferred endpoint; the
+        seeded-jitter tail (N-1 hashes + a sort) is only computed once
+        the preferred attempt has actually failed.  Recently-dead
+        endpoints are skipped (their arcs fail over; everything else is
+        untouched), and the owner is always the terminal fallback."""
+        now = time.monotonic()
+        for ep, until in list(self._dead.items()):
+            if until <= now:
+                del self._dead[ep]  # cooldown over: arcs come back
+        if len(self.ring) and objects:
+            key, _t, bucket = objects[0]
+            pref = self.ring.preferred(key, bucket)
+            if pref is not None and pref not in self._dead:
+                yield pref
+            for ep in self.ring.order(key, bucket)[1:]:
+                if ep not in self._dead:
+                    yield ep
+        yield self.owner
+
+    def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
+                     _relearn: bool = True):
+        """Session read: the key's ring-preferred follower first, then
+        the seeded-jittered survivor order, then the owner.  The reply's
+        snapshot clock folds into the token (monotonic reads).  A read
+        every endpoint refused re-learns the fleet once (when discovery
+        is wired) before surfacing the typed ReplicaDown."""
         from antidote_tpu.overload import ReplicaDown
 
-        n = len(self.followers)
-        order = [self.followers[(self._ridx + i) % n] for i in range(n)] \
-            if n else []
-        order.append(self.owner)
         last: Optional[BaseException] = None
-        for i, addr in enumerate(order):
+        for addr in self._read_candidates(objects):
             try:
                 vals, vc = self._conn(addr).read_objects(
                     objects, clock=self.token)
             except RemoteLagging as e:
                 self.redirects += 1
                 last = e
-                if n:
-                    self._ridx = (self._ridx + 1) % n
                 continue
             except RemoteNotOwner as e:
                 self.redirects += 1
@@ -358,17 +634,41 @@ class SessionClient:
                 continue
             except (ConnectionError, OSError) as ex:
                 self._drop(addr)
+                if addr != self.owner:
+                    # shed only this endpoint's arcs for a cooldown —
+                    # the rest of the ring keeps its routing
+                    self._dead[addr] = time.monotonic() + self.DEAD_S
                 self.failovers += 1
                 last = ex
-                if n and i < n:
-                    self._ridx = (self._ridx + 1) % n
                 continue
             self.observe(vc)
+            self.served_by[addr] = self.served_by.get(addr, 0) + 1
             return vals, vc
+        if self._discover and _relearn:
+            # the whole learned fleet may be stale (rolling restarts):
+            # one registry re-learn, then one more pass
+            try:
+                self.refresh_fleet()
+            except Exception:
+                pass
+            else:
+                return self.read_objects(objects, _relearn=False)
         raise ReplicaDown(
             "session read: every endpoint (followers and owner) "
             "refused or dropped the request"
         ) from last
+
+    def stats(self) -> dict:
+        """Session/ring observability: ring size, per-endpoint arc
+        shares, reads served per endpoint, redirects, failovers."""
+        return {
+            "ring_size": len(self.ring),
+            "arc_share": self.ring.arc_share_by_name(),
+            "served_by": {f"{h}:{p}": n
+                          for (h, p), n in sorted(self.served_by.items())},
+            "redirects": self.redirects,
+            "failovers": self.failovers,
+        }
 
     def close(self) -> None:
         for addr in list(self._conns):

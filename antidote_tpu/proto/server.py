@@ -406,26 +406,16 @@ class ProtocolServer:
                 # from the native msgpack codes, so existing
                 # antidotec_pb clients connect to the same port
                 if frame and frame[0] in apb.APB_REQUEST_CODES:
-                    if server_self.follower is not None:
-                        # the follower tier is native-dialect only: the
-                        # apb handlers dispatch straight into
-                        # update/txn paths, bypassing both the
-                        # not_owner write refusal (an ACKED local write
-                        # on a follower is guaranteed divergence that
-                        # the digest heal would later silently DISCARD)
-                        # and the session read gate — refuse the whole
-                        # dialect with the owner's address in the text
-                        server_self.metrics.session_redirects.inc(
-                            kind="not_owner")
-                        e = NotOwnerError(
-                            server_self.follower.owner_client_addr)
-                        resp_body = apb.overload_error(
-                            "not_owner", str(e), 0)
-                    else:
-                        resp_body = apb.handle_request(
-                            server_self, frame[0], frame[1:], conn_txns,
-                            lock=server_self._lock,
-                        )
+                    # the apb dialect rides the SAME follower discipline
+                    # the native dialect has (ISSUE 11): session reads
+                    # pass the token gate, writes/txns answer typed
+                    # not_owner redirects — both errmsg-encoded on
+                    # ApbErrorResp (apb.handle_request consults
+                    # server.follower per request name)
+                    resp_body = apb.handle_request(
+                        server_self, frame[0], frame[1:], conn_txns,
+                        lock=server_self._lock,
+                    )
                     try:
                         write_frame_body(self.request, resp_body)
                     except (ConnectionError, OSError):
@@ -1165,7 +1155,8 @@ class ProtocolServer:
                 # i.e. guaranteed divergence + an endless heal loop
                 MessageCode.CONNECT_TO_DCS,
                 MessageCode.CREATE_DC):
-            self.metrics.session_redirects.inc(kind="not_owner")
+            self.metrics.session_redirects.inc(kind="not_owner",
+                                               dialect="native")
             raise NotOwnerError(fol.owner_client_addr)
         # static ops route through the gate helpers OUTSIDE the lock (the
         # gate's dispatcher takes it; with batching off they lock inline)

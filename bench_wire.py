@@ -394,14 +394,17 @@ def _fanout_child(args) -> int:
     lats = [[] for _ in range(n)]
     redirects = [0] * n
     failovers = [0] * n
+    served: list = [{} for _ in range(n)]
     errs = []
 
     def worker(i):
         rng = np.random.default_rng(args.seed + i)
-        rot = (followers[i % len(followers):]
-               + followers[:i % len(followers)]) if followers else []
         try:
-            sc = SessionClient((args.host, args.port), rot)
+            # hash-ring routing (ISSUE 11): every worker agrees on each
+            # key's preferred replica; the per-worker seed jitters only
+            # the failover order
+            sc = SessionClient((args.host, args.port), followers,
+                               seed=args.seed + i)
             wkey = f"sess-{args.seed}-{i}"
             wcount = 0
             j = 0
@@ -425,6 +428,8 @@ def _fanout_child(args) -> int:
                 reads[i] += 1
             redirects[i] = sc.redirects
             failovers[i] = sc.failovers
+            served[i] = {f"{h}:{p}": c
+                         for (h, p), c in sc.served_by.items()}
             sc.close()
         except Exception as e:  # pragma: no cover - failure detail
             errs.append(repr(e))
@@ -438,10 +443,15 @@ def _fanout_child(args) -> int:
     if len(lat) > 20_000:
         idx = np.linspace(0, len(lat) - 1, 20_000).astype(int)
         lat = list(np.asarray(lat)[idx])
+    served_by: dict = {}
+    for d in served:
+        for ep, c in d.items():
+            served_by[ep] = served_by.get(ep, 0) + c
     print(json.dumps({"reads": sum(reads), "writes": sum(writes),
                       "violations": sum(violations),
                       "redirects": sum(redirects),
                       "failovers": sum(failovers),
+                      "served_by": served_by,
                       "lat_ms": lat, "errs": errs}))
     return 0
 
@@ -963,12 +973,18 @@ def bench_perf_smoke_write(assert_bounds: bool, json_path=None):
 #: is held constant PER FOLLOWER (the basho_bench shape — clients scale
 #: with the serving fleet), so each point measures what the fleet can
 #: aggregate rather than how thin a fixed client pool spreads
-FOLLOWER_FANOUT = {"counts": (1, 2, 4), "workers_per_endpoint": 8,
+FOLLOWER_FANOUT = {"counts": (1, 2, 4, 8), "workers_per_endpoint": 8,
                    "procs": 2, "duration_s": 8, "keys": 4096,
                    "prefill": 1024, "park_ms": 300}
 FOLLOWER_FANOUT_SMOKE = {"counts": (1, 2), "workers_per_endpoint": 6,
                          "procs": 2, "duration_s": 3, "keys": 512,
                          "prefill": 128, "park_ms": 300}
+#: `make fleet-smoke` (ISSUE 11): one hash-routed 4-follower point,
+#: gated structurally — zero session violations AND every follower's
+#: ring arcs actually served reads (never a throughput ratchet)
+FLEET_FANOUT_SMOKE = {"counts": (4,), "workers_per_endpoint": 5,
+                      "procs": 2, "duration_s": 4, "keys": 1024,
+                      "prefill": 256, "park_ms": 300}
 
 
 def _run_fanout_mp(owner_info, follower_addrs, workers, duration, keys,
@@ -986,7 +1002,8 @@ def _run_fanout_mp(owner_info, follower_addrs, workers, duration, keys,
             env=_env(), stdout=subprocess.PIPE,
         ))
     agg = {"reads": 0, "writes": 0, "violations": 0, "redirects": 0,
-           "failovers": 0, "lat_ms": [], "workers": per * n_procs}
+           "failovers": 0, "lat_ms": [], "served_by": {},
+           "workers": per * n_procs}
     fails = []
     for p in procs:
         out, _ = p.communicate(timeout=duration + 180)
@@ -998,26 +1015,31 @@ def _run_fanout_mp(owner_info, follower_addrs, workers, duration, keys,
         for k in ("reads", "writes", "violations", "redirects",
                   "failovers"):
             agg[k] += d[k]
+        for ep, c in d.get("served_by", {}).items():
+            agg["served_by"][ep] = agg["served_by"].get(ep, 0) + c
         agg["lat_ms"].extend(d["lat_ms"])
     assert not fails, f"fanout children failed: {fails}"
     return agg
 
 
 def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
-                          json_path=None):
-    """Aggregate session-read throughput at 1/2/4 followers (ISSUE 9):
-    one owner + N follower processes (console serve --follower-of, image
-    bootstrap off a real checkpoint), driven by SessionClients that
-    assert read-your-writes on every write→read pair.  Frozen into the
-    cluster artifact under ``follower_fanout``; the --assert-bounds gate
-    is STRUCTURAL (zero session violations, nonzero throughput at every
-    point) — never a throughput ratchet."""
+                          json_path=None, fleet: bool = False):
+    """Aggregate session-read throughput at 1/2/4/8 hash-routed
+    followers (ISSUE 9/11): one owner + N follower processes (console
+    serve --follower-of, image bootstrap off a real checkpoint), driven
+    by SessionClients routing over the consistent-hash ring and
+    asserting read-your-writes on every write→read pair.  Frozen into
+    the cluster artifact under ``follower_fanout``; the --assert-bounds
+    gate is STRUCTURAL (zero session violations, nonzero throughput at
+    every point; in --fleet-smoke mode additionally: every follower's
+    ring arcs served reads) — never a throughput ratchet."""
     import shutil
     import tempfile
 
-    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.client import AntidoteClient, HashRing
 
-    ff = dict(FOLLOWER_FANOUT_SMOKE if smoke else FOLLOWER_FANOUT)
+    ff = dict(FLEET_FANOUT_SMOKE if fleet
+              else FOLLOWER_FANOUT_SMOKE if smoke else FOLLOWER_FANOUT)
     td = tempfile.mkdtemp(prefix="bench_fanout_")
     shards = 8
     owner = subprocess.Popen(
@@ -1071,6 +1093,8 @@ def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
             res = _run_fanout_mp(oinfo, addrs, workers,
                                  ff["duration_s"], ff["keys"],
                                  ff["procs"], seed0=40_000 * (n + 1))
+            ring = HashRing(addrs)
+            shares = ring.arc_share()
             point = {
                 "followers": n,
                 "read_ops_per_s": round(res["reads"]
@@ -1080,6 +1104,13 @@ def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
                 "redirects": res["redirects"],
                 "failovers": res["failovers"],
                 "workers": res["workers"],
+                "endpoints": [f"{h}:{p}" for h, p in addrs],
+                "served_by": dict(sorted(res["served_by"].items())),
+                "ring": {
+                    "size": len(ring),
+                    "arc_share_min": round(min(shares.values()), 4),
+                    "arc_share_max": round(max(shares.values()), 4),
+                },
                 **_percentiles(res["lat_ms"]),
             }
             points.append(point)
@@ -1101,17 +1132,22 @@ def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
         shutil.rmtree(td, ignore_errors=True)  # reclaim-ok: bench
         # scratch dirs (owner + follower WALs), never production data
     out = {"driver": {"rev": DRIVER_REV, **ff,
-                      "counts": list(ff["counts"]), "smoke": smoke},
+                      "counts": list(ff["counts"]), "smoke": smoke,
+                      "routing": "hash-ring", "fleet_smoke": fleet},
            "points": points,
            "host_note": (
                "2-core shared container: every follower PROCESS contends "
                "for the same cores as the owner and the driver, so the "
-               "curve bends far below linear (each point also pays "
-               "n_followers x replication apply work); offered "
-               "concurrency is fixed per endpoint (workers_per_endpoint) "
-               "so points measure aggregate fleet capacity.  On a host "
-               "with >= n_followers+1 cores the owner offload is the "
-               "whole point — reads never touch it.")}
+               "curve bends far below linear and INVERTS past ~4 "
+               "followers (the 8-point runs 9 serving processes + the "
+               "driver on 2 cores; each point also pays n_followers x "
+               "replication apply work); offered concurrency is fixed "
+               "per endpoint (workers_per_endpoint) so points measure "
+               "aggregate fleet capacity.  The structural signal at 8 "
+               "is COVERAGE: zero session violations and every ring "
+               "arc served.  On a host with >= n_followers+1 cores the "
+               "owner offload is the whole point — reads never touch "
+               "it.")}
     print(json.dumps(out), flush=True)
     if assert_bounds:
         # STRUCTURAL gate: the session guarantees held at every fanout
@@ -1119,6 +1155,15 @@ def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
         # recorded, not gated (shared-host noise must not flake CI)
         assert all(p["session_violations"] == 0 for p in points), points
         assert all(p["read_ops_per_s"] > 0 for p in points), points
+        if fleet:
+            # fleet-smoke additionally requires COVERAGE: every
+            # follower's ring arcs actually served reads (a mis-built
+            # ring routing everything to one endpoint, or a follower
+            # wedged behind its gate, fails here)
+            for p in points:
+                unserved = [ep for ep in p["endpoints"]
+                            if p["served_by"].get(ep, 0) <= 0]
+                assert not unserved, (unserved, p["served_by"])
     if json_path:
         _write_artifact(json_path, follower_fanout=out)
     return out
@@ -1146,13 +1191,20 @@ def main():
                          "unless throughput >= 0.8 x the artifact's "
                          "frozen perf_smoke_write value")
     ap.add_argument("--follower-fanout", action="store_true",
-                    help="follower read-tier scaling (ISSUE 9): owner + "
-                         "1/2/4 follower processes, SessionClient "
-                         "drivers asserting read-your-writes per op; "
-                         "frozen under follower_fanout in the cluster "
+                    help="follower read-tier scaling (ISSUE 9/11): "
+                         "owner + 1/2/4/8 follower processes, "
+                         "hash-ring-routed SessionClient drivers "
+                         "asserting read-your-writes per op; frozen "
+                         "under follower_fanout in the cluster "
                          "artifact.  With --assert-bounds: structural "
                          "gate only (zero session violations, nonzero "
                          "throughput — `make replica-smoke`)")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="one hash-routed 4-follower fanout point with "
+                         "the COVERAGE gate: zero session violations "
+                         "AND every follower's ring arcs served reads "
+                         "(`make fleet-smoke`; never freezes, never a "
+                         "throughput ratchet)")
     ap.add_argument("--assert-bounds", action="store_true",
                     help="with --saturation: fail unless goodput stays "
                          "within 20%% of peak past the knee (the `make "
@@ -1182,6 +1234,10 @@ def main():
     if args.fanout_child:
         sys.exit(_fanout_child(args))
     smoke = args.smoke
+    if args.fleet_smoke:
+        bench_follower_fanout(True, assert_bounds=args.assert_bounds,
+                              json_path=None, fleet=True)
+        return 0
     if args.follower_fanout:
         # smoke runs are the structural CI gate and must not overwrite
         # the frozen scaling curve; freezing is an explicit full run

@@ -1196,13 +1196,23 @@ def test_follower_tier_sigkill_failover_and_rejoin(tmp_path):
                 totals[k] += 1
         f1.send_signal(signal.SIGKILL)
         f1.wait(timeout=10)
+        f1_addr = (i1["host"], i1["port"])
+        served_dead_before = sc.served_by.get(f1_addr, 0)
+        re_before, fo_before = sc.redirects, sc.failovers
         for r in range(8):
             k = keys[r % len(keys)]
             sc.update_objects([(k, "counter_pn", "b", ("increment", 1))])
             totals[k] += 1
             vals, _ = sc.read_objects([(k, "counter_pn", "b")])
             assert vals == [totals[k]], (k, vals, totals[k])
-        assert sc.failovers + sc.redirects >= 1
+        # ring semantics: the dead follower served nothing after the
+        # kill; arcs it owned failed over (dead socket or one last
+        # typed redirect from the dying process — either counter),
+        # other arcs were untouched — conditional on arc ownership
+        assert sc.served_by.get(f1_addr, 0) == served_dead_before
+        if any(sc.ring.preferred(k, "b") == f1_addr for k in keys):
+            assert (sc.redirects - re_before
+                    + sc.failovers - fo_before) >= 1
         # phase 3: rejoin f1 from its images (local checkpoint + the
         # owner's shipped image/tail) and converge byte-identical
         f1b = spawn_follower("f1", oinfo)
@@ -1246,6 +1256,209 @@ def test_follower_tier_sigkill_failover_and_rejoin(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# scenario 16: the planet-scale session fabric under fire (ISSUE 11) — a
+# hash-routed fleet of 4 followers shadowing a 2-member CLUSTERED owner
+# under a seeded drop/delay storm; SIGKILL one follower mid-storm AND
+# live-move a shard between the owner's members mid-storm; every session
+# read must satisfy read-your-writes/monotonic reads through it all, and
+# the killed follower rejoins digest-clean
+# ---------------------------------------------------------------------------
+def test_hashed_fleet_clustered_owner_sigkill_and_shard_move(tmp_path):
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.cluster import ClusterNode, attach_interdc
+    from antidote_tpu.cluster.join import _move_shard
+    from antidote_tpu.cluster.member import ClusterMember
+    from antidote_tpu.cluster.rpc import RpcClient
+    from antidote_tpu.proto.client import SessionClient
+    from antidote_tpu.proto.server import ProtocolServer
+
+    ccfg = AntidoteConfig(n_shards=4, max_dcs=2)
+    env_follower = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # seeded drop/delay storm on every follower's subscription
+        # streams: chain gaps open constantly and heal through the
+        # per-member routed catch-up
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 16, "rules": [
+            {"site": "interdc.deliver", "action": "drop", "p": 0.08,
+             "times": 300},
+            {"site": "interdc.deliver", "action": "delay", "p": 0.08,
+             "times": 300},
+        ]}),
+    )
+    fab = TcpFabric(backoff_base=0.05, backoff_max=0.5)
+    ms = [ClusterMember(ccfg, dc_id=0, member_id=i, n_members=2,
+                        log_dir=str(tmp_path / f"m{i}"))
+          for i in range(2)]
+    for a in ms:
+        for b in ms:
+            if a is not b:
+                a.connect(b.member_id, *b.address)
+    reps = [attach_interdc(m, fab) for m in ms]
+    # one wire server per member (interdc=rep: serves the member's
+    # descriptor + replica registry) — the console follower path learns
+    # the fleet endpoint by endpoint from these
+    srvs = [ProtocolServer(ClusterNode(m), port=0, interdc=r)
+            for m, r in zip(ms, reps)]
+    owner_list = ",".join(f"{s.host}:{s.port}" for s in srvs)
+    coord = ClusterNode(ms[0])
+
+    stop = threading.Event()
+
+    def pumper():
+        while not stop.is_set():
+            fab.pump(timeout=0.05)
+            for m in ms:
+                try:
+                    m.refresh_peer_clocks()
+                except Exception:
+                    pass
+
+    pump_t = threading.Thread(target=pumper, daemon=True)
+    pump_t.start()
+
+    def spawn_follower(name):
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--log-dir", str(tmp_path / name),
+             "--follower-of", owner_list,
+             "--replica-name", name, "--follower-park-ms", "400",
+             "--divergence-check-s", "0.5"],
+            stdout=subprocess.PIPE,
+            stderr=open(str(tmp_path / (name + ".log")), "a"),
+            env=env_follower, text=True,
+        )
+
+    followers = {}
+    procs = []
+    f3b = None
+    try:
+        keys = [f"k{i}" for i in range(8)]  # spread over all 4 shards
+        totals = {k: 0 for k in keys}
+        for _ in range(3):
+            for k in keys:
+                coord.update_objects([(k, "counter_pn", "b",
+                                       ("increment", 1))])
+                totals[k] += 1
+        # one image per member, so every follower composes the fleet's
+        # images at bootstrap (the path under test)
+        for m in ms:
+            m.node.checkpoint_now()
+        for i in range(4):
+            followers[f"f{i}"] = spawn_follower(f"f{i}")
+        procs.extend(followers.values())
+        infos = {}
+        for name, p in followers.items():
+            infos[name] = json.loads(p.stdout.readline())
+            assert infos[name]["ready"]
+            assert infos[name]["bootstrap"] == "image"
+            assert infos[name]["fleet"]["owner_members"] == 2
+        sc = SessionClient(
+            (srvs[0].host, srvs[0].port),
+            [(infos[f"f{i}"]["host"], infos[f"f{i}"]["port"])
+             for i in range(4)],
+            seed=1616,
+        )
+
+        def session_round(r):
+            k = keys[r % len(keys)]
+            sc.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+            totals[k] += 1
+            vals, _ = sc.read_objects([(k, "counter_pn", "b")])
+            assert vals == [totals[k]], (k, vals, totals[k])
+
+        # phase 1: the storm alone — RYW on every single read
+        for r in range(8):
+            session_round(r)
+        # phase 2: a write burst (catch-up pressure), then SIGKILL f3
+        # mid-storm — the ring sheds only f3's arcs, sessions keep RYW
+        for k in keys:
+            for _ in range(3):
+                coord.update_objects([(k, "counter_pn", "b",
+                                       ("increment", 1))])
+                totals[k] += 1
+        f3 = followers["f3"]
+        f3.send_signal(signal.SIGKILL)
+        f3.wait(timeout=10)
+        f3_addr = (infos["f3"]["host"], infos["f3"]["port"])
+        served_dead_before = sc.served_by.get(f3_addr, 0)
+        for r in range(8):
+            session_round(r)
+        assert sc.served_by.get(f3_addr, 0) == served_dead_before
+        # phase 3: LIVE shard move between the owner's members,
+        # mid-storm — epoch gossip re-points every follower's catch-up
+        # with no reconnect; sessions keep RYW through the move
+        moved = next(s for s in range(ccfg.n_shards)
+                     if s in ms[1].shards)
+        clients = {m.member_id: RpcClient(*m.address) for m in ms}
+        try:
+            _move_shard(clients, moved, 1, 0, 2)
+        finally:
+            for c in clients.values():
+                c.close()
+        assert moved in ms[0].shards
+        for r in range(12):
+            session_round(r)
+        # phase 4: rejoin f3 from its local state + the fleet's images
+        # and require a digest-clean convergence (ok sweeps, zero
+        # mismatches) plus the full totals at the session token
+        f3b = spawn_follower("f3")
+        procs.append(f3b)
+        i3b = json.loads(f3b.stdout.readline())
+        assert i3b["ready"]
+        from antidote_tpu.proto.client import AntidoteClient, RemoteLagging
+
+        fc = AntidoteClient(i3b["host"], i3b["port"])
+        objs = [(k, "counter_pn", "b") for k in keys]
+        token = sc.token
+        deadline = time.monotonic() + 90
+        while True:
+            try:
+                vals, _ = fc.read_objects(objs, clock=token)
+            except RemoteLagging:
+                vals = None
+            if vals == [totals[k] for k in keys]:
+                st = fc.node_status()["replicas"]
+                if (st["state"] == "serving"
+                        and st["divergence"].get("ok", 0) >= 1
+                        and st["divergence"].get("mismatch", 0) == 0):
+                    break
+            assert time.monotonic() < deadline, (
+                f"rejoined follower never converged digest-clean: "
+                f"{vals} != {totals}")
+            time.sleep(0.2)
+        # both members' registries see the surviving fleet as ok
+        reg = reps[0].replica_status()["followers"]
+        for name in ("f0", "f1", "f2", "f3"):
+            assert reg[name]["state"] in ("ok", "lagging"), reg
+        fc.close()
+        sc.close()
+    finally:
+        stop.set()
+        pump_t.join(timeout=10)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        for s in srvs:
+            s.close()
+        for m in ms:
+            try:
+                m.close()
+            except Exception:
+                pass
+        fab.close()
 
 
 # ---------------------------------------------------------------------------

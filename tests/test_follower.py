@@ -327,7 +327,8 @@ def test_gate_read_parks_then_redirects(cfg, tmp_path):
     assert time.monotonic() - t0 >= 0.04
     assert ei.value.redirect == ["owner-host", 1234]
     assert ei.value.retry_after_ms > 0
-    assert fnode.metrics.session_redirects.value(kind="lagging") >= 1
+    assert fnode.metrics.session_redirects.value(
+        kind="lagging", dialect="native") >= 1
     owner.store.log.close(), fnode.store.log.close()
 
 
@@ -357,6 +358,268 @@ def test_owner_replica_registry_and_liveness(cfg, tmp_path):
     assert out["followers"]["f1"]["state"] == "down"
     fol._send_report()
     assert orep.replica_status()["followers"]["f1"]["state"] == "ok"
+    owner.store.log.close(), fnode.store.log.close()
+
+
+# ---------------------------------------------------------------------------
+# Part C — fleet shadowing (ISSUE 11): clustered / geo owners, the apb
+# session tier, streak-scaled gate hints
+# ---------------------------------------------------------------------------
+def test_clustered_owner_fleet_shadowing_and_live_shard_move(tmp_path):
+    """A follower shadows a 2-member CLUSTERED owner: bootstrap composes
+    both members' checkpoint images (each restricted to its owned
+    shards), the live tail flows over per-member subscriptions, session
+    reads are byte-identical to the owner at equal applied clocks
+    (divergence digest clean on every shard against whichever member
+    owns it), and a LIVE shard move mid-stream re-points catch-up +
+    digest routing through the ownership-epoch gossip with no
+    reconnect."""
+    from antidote_tpu.cluster import ClusterNode, attach_interdc
+    from antidote_tpu.cluster.join import _move_shard
+    from antidote_tpu.cluster.member import ClusterMember
+    from antidote_tpu.cluster.rpc import RpcClient
+    from antidote_tpu.interdc.tcp import TcpFabric
+
+    ccfg = AntidoteConfig(
+        n_shards=4, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=4, keys_per_table=32, batch_buckets=(8,),
+    )
+    fab = TcpFabric(backoff_base=0.05, backoff_max=0.5)
+    ffab = TcpFabric(backoff_base=0.05, backoff_max=0.5)
+    ms = [ClusterMember(ccfg, dc_id=0, member_id=i, n_members=2,
+                        log_dir=str(tmp_path / f"m{i}"))
+          for i in range(2)]
+    for a in ms:
+        for b in ms:
+            if a is not b:
+                a.connect(b.member_id, *b.address)
+    reps = [attach_interdc(m, fab) for m in ms]
+    coord = ClusterNode(ms[0])
+    fnode = fol = None
+    try:
+        n_keys = 8
+        for r in range(3):
+            for k in range(n_keys):
+                coord.update_objects([(k, "counter_pn", "b",
+                                       ("increment", 1))])
+        for m in ms:
+            m.node.checkpoint_now()
+        # blank follower: per-member image composition
+        fnode = AntidoteNode(ccfg, dc_id=0,
+                             log_dir=str(tmp_path / "fol"))
+        fol = FollowerReplica(fnode, ffab, "cf1",
+                              owner_client_addr=("owner-host", 1),
+                              fabric_id=301)
+        mode = fol.attach([r.descriptor() for r in reps])
+        assert mode == "image"
+        assert fol.state == "serving"
+        assert len(fol.member_fids) == 2
+        objs = [(k, "counter_pn", "b") for k in range(n_keys)]
+
+        def converge_fleet(expect):
+            deadline = time.monotonic() + 60
+            while True:
+                for r in reps:
+                    r.heartbeat()
+                for m in ms:
+                    m.refresh_peer_clocks()
+                fab.pump(timeout=0.05)
+                ffab.pump(timeout=0.05)
+                target = np.maximum.reduce(
+                    [m.node.store.dc_max_vc() for m in ms])
+                if (fnode.store.stable_vc() >= target).all():
+                    got, _ = fnode.read_objects(objs, clock=target)
+                    if got == expect:
+                        return target
+                assert time.monotonic() < deadline, (
+                    f"fleet follower never converged: "
+                    f"{fnode.store.stable_vc()} < {target}")
+
+        converge_fleet([3] * n_keys)
+        res = fol.check_divergence()
+        assert all(v == "ok" for v in res.values()), res
+        # live tail keeps flowing from BOTH members
+        for k in range(n_keys):
+            coord.update_objects([(k, "counter_pn", "b",
+                                   ("increment", 1))])
+        converge_fleet([4] * n_keys)
+        # LIVE shard move mid-fleet: member 1 -> member 0; the follower
+        # keeps its (already-open) subscriptions and the ownership-epoch
+        # gossip re-points catch-up + digest routing — no reconnect
+        moved = next(s for s in range(ccfg.n_shards)
+                     if s in ms[1].shards)
+        clients = {m.member_id: RpcClient(*m.address) for m in ms}
+        try:
+            _move_shard(clients, moved, 1, 0, 2)
+        finally:
+            for c in clients.values():
+                c.close()
+        assert moved in ms[0].shards and moved not in ms[1].shards
+        for k in range(n_keys):
+            coord.update_objects([(k, "counter_pn", "b",
+                                   ("increment", 1))])
+        converge_fleet([5] * n_keys)
+        # the follower learned the move from the egress gossip and now
+        # routes the moved shard's digest to the NEW owner
+        assert fol.shard_route[(0, moved)][0] == 0
+        res = fol.check_divergence()
+        assert all(v == "ok" for v in res.values()), res
+        assert fol._route(0, moved) == reps[0].fabric_id
+        # both members' registries saw the follower's reports
+        for r in reps:
+            st = r.replica_status()
+            assert "cf1" in st["followers"], st
+    finally:
+        for m in ms:
+            try:
+                m.close()
+            except Exception:
+                pass
+        fab.close()
+        ffab.close()
+        if fnode is not None and fnode.store.log is not None:
+            fnode.store.log.close()
+
+
+def test_geo_owner_shadowing_peer_chains(cfg, tmp_path):
+    """A follower of a GEO-REPLICATED owner subscribes to the peer DC's
+    stream too (its descriptor is part of the fleet), applies the peer's
+    origin chain through the same causal gate the owner does, and
+    converges byte-identical — divergence digests clean across every
+    lane at equal applied clocks."""
+    hub = LoopbackHub()
+    owner, orep = mk_owner(cfg, hub, tmp_path)
+    peer = AntidoteNode(cfg, dc_id=1, log_dir=str(tmp_path / "peer"))
+    prep = DCReplica(peer, hub, "dc1")
+    orep.observe_dc(prep)
+    prep.observe_dc(orep)
+    for i in range(3):
+        owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+        peer.update_objects([("k", "counter_pn", "b", ("increment", 10))])
+    owner.checkpoint_now()
+    fnode = AntidoteNode(cfg, dc_id=0, log_dir=str(tmp_path / "gf"))
+    fol = FollowerReplica(fnode, hub, "gf1",
+                          owner_client_addr=("owner-host", 1234),
+                          fabric_id=99)
+    mode = fol.attach([orep.descriptor(), prep.descriptor()])
+    assert mode == "image"
+    assert sorted(fol.fleet_by_dc) == [0, 1]
+    objs = [("k", "counter_pn", "b")]
+    # the live tail: both origins' later commits reach the follower over
+    # its OWN subscriptions (the owner never re-publishes peer effects)
+    owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    peer.update_objects([("k", "counter_pn", "b", ("increment", 10))])
+    deadline = time.monotonic() + 30
+    while True:
+        orep.heartbeat()
+        prep.heartbeat()
+        hub.pump()
+        target = np.maximum(owner.store.dc_max_vc(),
+                            peer.store.dc_max_vc())
+        if (fnode.store.stable_vc() >= target).all():
+            break
+        assert time.monotonic() < deadline
+    want, _ = owner.read_objects(objs, clock=target)
+    got, _ = fnode.read_objects(objs, clock=target)
+    assert got == want == [44]
+    res = fol.check_divergence()
+    assert all(v == "ok" for v in res.values()), res
+    assert fol.replica_status()["fleet"]["peer_dcs"] == [1]
+    owner.store.log.close()
+    peer.store.log.close()
+    fnode.store.log.close()
+
+
+def test_apb_session_tier_on_follower(cfg, tmp_path):
+    """The apb protobuf dialect gets the SAME session discipline the
+    msgpack dialect has on a follower (ISSUE 11): token-gated static
+    reads serve (with RYW via the session token), writes/txns answer
+    typed not_owner redirects, and a stale replica answers typed
+    lagging — all errmsg-encoded on ApbErrorResp and decoded back by
+    the apb client into the same Remote* exceptions."""
+    from antidote_tpu.proto.client import (ApbClient, RemoteLagging,
+                                           RemoteNotOwner, SessionClient)
+    from antidote_tpu.proto.server import ProtocolServer
+
+    hub = LoopbackHub()
+    owner, orep = mk_owner(cfg, hub, tmp_path)
+    owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    owner.checkpoint_now()
+    fnode, fol, _ = mk_follower(cfg, hub, tmp_path, orep, park_s=0.05)
+    osrv = ProtocolServer(owner, port=0, interdc=orep)
+    fsrv = ProtocolServer(fnode, port=0, follower=fol)
+    fol.owner_client_addr = (osrv.host, osrv.port)
+    try:
+        # apb write at the follower: typed not_owner WITH the redirect
+        fc = ApbClient(fsrv.host, fsrv.port)
+        with pytest.raises(RemoteNotOwner) as ei:
+            fc.update_objects([(b"k", "counter_pn", b"b",
+                                ("increment", 1))])
+        assert ei.value.redirect == [osrv.host, osrv.port]
+        assert fnode.metrics.session_redirects.value(
+            kind="not_owner", dialect="apb") >= 1
+        # apb session over the fleet: write owner, read follower, RYW
+        sc = SessionClient((osrv.host, osrv.port),
+                           [(fsrv.host, fsrv.port)], dialect="apb")
+        total = 0
+        for i in range(4):
+            sc.update_objects([(b"ak", "counter_pn", b"b",
+                                ("increment", 1))])
+            total += 1
+            # converge the follower so the gate admits promptly
+            for _ in range(40):
+                orep.heartbeat()
+                hub.pump()
+                if (fnode.store.dc_max_vc()
+                        >= owner.store.dc_max_vc()).all():
+                    break
+            vals, _ = sc.read_objects([(b"ak", "counter_pn", b"b")])
+            assert vals == [total], (i, vals, total)
+        assert sc.served_by.get((fsrv.host, fsrv.port), 0) >= 1
+        # a token ahead of the replica: typed lagging with retry hint +
+        # redirect, errmsg round-tripped
+        ahead = [int(x) + 50 for x in owner.store.dc_max_vc()]
+        fc2 = ApbClient(fsrv.host, fsrv.port)
+        with pytest.raises(RemoteLagging) as ei:
+            fc2.read_objects([(b"ak", "counter_pn", b"b")], clock=ahead)
+        assert ei.value.retry_after_ms > 0
+        assert ei.value.redirect == [osrv.host, osrv.port]
+        assert fnode.metrics.session_redirects.value(
+            kind="lagging", dialect="apb") >= 1
+        fc.close(), fc2.close(), sc.close()
+    finally:
+        fsrv.close()
+        osrv.close()
+        owner.store.log.close(), fnode.store.log.close()
+
+
+def test_gate_retry_hint_scales_with_refusal_streak(cfg, tmp_path):
+    """Satellite: the follower gate's retry hint scales with the
+    refusal streak since the last admitted read (25..500 ms, the
+    AdmissionGate discipline) — a parked fleet backs off instead of
+    hammering a lagging follower on a fixed hint."""
+    from antidote_tpu.overload import ReplicaLagging
+
+    hub = LoopbackHub()
+    owner, orep = mk_owner(cfg, hub, tmp_path)
+    owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    fnode, fol, _ = mk_follower(cfg, hub, tmp_path, orep, park_s=0.0)
+    converge(owner, orep, hub, fnode, [("k", "counter_pn", "b")])
+    ahead = owner.store.dc_max_vc().astype(np.int64) + 50
+    hints = []
+    for _ in range(40):
+        with pytest.raises(ReplicaLagging) as ei:
+            fol.gate_read([("k", "counter_pn", "b")], ahead)
+        hints.append(ei.value.retry_after_ms)
+    assert hints[0] == 25
+    assert hints[-1] > hints[0]
+    assert max(hints) <= 500
+    # an admitted read resets the streak — hints start over
+    fol.gate_read([("k", "counter_pn", "b")],
+                  np.asarray(fnode.store.dc_max_vc()))
+    with pytest.raises(ReplicaLagging) as ei:
+        fol.gate_read([("k", "counter_pn", "b")], ahead)
+    assert ei.value.retry_after_ms == 25
     owner.store.log.close(), fnode.store.log.close()
 
 
@@ -465,13 +728,24 @@ def test_wire_session_survives_follower_kill_and_rejoin(cfg, tmp_path):
             f1["srv"].close()
             f1["fabric"].close()
             f1["node"].store.log.close()
+            f1_addr = (f1["srv"].host, f1["srv"].port)
+            served_dead_before = sc.served_by.get(f1_addr, 0)
+            re_before, fo_before = sc.redirects, sc.failovers
             for i in range(4):
                 sc.update_objects([("k", "counter_pn", "b",
                                     ("increment", 1))])
                 total += 1
                 vals, _ = sc.read_objects([("k", "counter_pn", "b")])
                 assert vals == [total], (i, vals, total)
-            assert sc.failovers + sc.redirects >= 1
+            # ring semantics: the dead follower served nothing after the
+            # kill — its arcs failed over when "k" preferred it (a
+            # winding-down server may answer one last typed redirect
+            # instead of a dead socket, so either counter may move),
+            # and other arcs were untouched (no stampede to assert)
+            assert sc.served_by.get(f1_addr, 0) == served_dead_before
+            if sc.ring.preferred("k", "b") == f1_addr:
+                assert (sc.redirects - re_before
+                        + sc.failovers - fo_before) >= 1
             # rejoin follower 1 from its local image + the owner's tail
             f1b = _wire_follower(cfg, tmp_path, osrv, "wf1", 103,
                                  recover=True)
