@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke replica-smoke fleet-smoke mesh-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -49,6 +49,18 @@ perf-smoke:
 restart-smoke:
 	$(PY) tools/bench_restart.py --smoke --assert-bounds
 	$(PY) -m pytest tests/test_checkpoint.py -q
+
+# beyond-RAM survival (ISSUE 13): cold-tier + Merkle unit suite, the
+# incremental-vs-full stamp gate (delta rows == dirty writes, bytes and
+# wall-clock undercut the rebase), and a small beyond-budget populate →
+# SIGKILL → cold recovery run asserting the STRUCTURAL gates only
+# (resident rows ≤ budget + one rebase window, sample reads byte-exact
+# after fault-in) — the frozen BENCH_RESTART_cpu.json curves are never
+# a ratchet
+coldtier-smoke:
+	$(PY) -m pytest tests/test_coldtier.py -q
+	$(PY) tools/bench_restart.py --incremental --smoke --assert-bounds
+	$(PY) tools/bench_restart.py --coldtier-smoke --assert-bounds
 
 # follower read tier (ISSUE 9): the deterministic follower suite plus a
 # short live fanout run — owner + followers boot for real, SessionClients

@@ -43,6 +43,8 @@ class AntidoteNode:
         recover: bool = False,
         meta=None,
         store: Optional[KVStore] = None,
+        resident_rows: int = 0,
+        cold_fault_rate_cap: float = 0.0,
     ):
         """``store`` adopts an existing KVStore (e.g. the output of
         ``handoff.reshard``) instead of building one; ``log_dir`` must be
@@ -138,33 +140,67 @@ class AntidoteNode:
         #: (cluster members register their membership snapshot here);
         #: shared with the Checkpointer so late registrations are seen
         self.checkpoint_extras_providers: dict = {}
+        # --- cold tier (ISSUE 13): attach BEFORE recovery so a chain
+        # image's cold_directory can register fault-in refs and the tail
+        # replay stays under the resident budget
+        if resident_rows > 0 and self.store.cold is None:
+            # enable_cold_tier raises without a durable log — the
+            # explicitly-requested residency bound must never be a
+            # silent no-op
+            self.enable_cold_tier(resident_rows, cold_fault_rate_cap)
         if recover and log is not None:
             # node restart (check_node_restart,
             # /root/reference/src/inter_dc_manager.erl:156-206).  Fast
-            # path (ISSUE 8): install the newest published checkpoint
-            # image, then replay only the WAL tail above its floor; the
-            # full-log replay remains the no-checkpoint fallback and the
-            # semantics oracle (both rebuild certification + counters).
+            # path (ISSUE 8/13): compose the newest verifiable FULL
+            # checkpoint image with its parent-linked delta chain, then
+            # replay only the WAL tail above the last good link's floor;
+            # the full-log replay remains the no-checkpoint fallback and
+            # the semantics oracle (both rebuild certification +
+            # counters).  A corrupt mid-chain link truncates the
+            # composition — the tail above the surviving prefix is still
+            # on disk (reclaim never passes the retained fulls' floors).
             from antidote_tpu.log import checkpoint as _ckpt
 
             rlog = logging.getLogger("antidote_tpu.recovery")
             t0 = time.monotonic()
-            loaded = _ckpt.load_latest(log_dir)
+            loaded = _ckpt.load_chain(log_dir)
             if loaded is not None:
-                image, manifest = loaded
+                image, manifest, deltas = loaded
                 summary = _ckpt.install_image(self.store, self.txm, image)
                 self.checkpoint_extras = image.get("extras", {}) or {}
+                if summary["cold_directory"]:
+                    # beyond-RAM image: the cold keys get NO device row —
+                    # reads fault them in against this image's sidecar
+                    if self.store.cold is None:
+                        self.enable_cold_tier(0, cold_fault_rate_cap)
+                    self.store.cold.seed(summary["cold_directory"],
+                                         int(manifest["id"]))
+                if self.store.cold is not None \
+                        and (manifest.get("cold") is not None):
+                    # resident keys' image coords double as evict hints
+                    # (their rows ARE the sidecar rows) — the budget
+                    # pass below and the commit path both need them
+                    self.store.cold.seed_hints(int(manifest["id"]))
+                for delta, dman in deltas:
+                    ds = _ckpt.install_delta(self.store, self.txm, delta)
+                    self.checkpoint_extras.update(
+                        delta.get("extras", {}) or {})
+                    rlog.info(
+                        "recovery chain link %d: %d rows, %d keys, "
+                        "%d evicted", ds["id"], ds["rows"], ds["keys"],
+                        ds["evicted"])
                 ckpt_s = time.monotonic() - t0
                 self.metrics.recovery_seconds.set(ckpt_s,
                                                   phase="checkpoint")
                 rlog.info(
-                    "recovery phase checkpoint: image %d (%d keys, %d "
-                    "rows, %d tables%s) installed in %.2f s",
-                    summary["id"], summary["keys"], summary["rows"],
-                    summary["tables"],
+                    "recovery phase checkpoint: image %d + %d chain "
+                    "link(s) (%d keys, %d rows, %d tables%s, %d cold) "
+                    "installed in %.2f s",
+                    summary["id"], len(deltas), summary["keys"],
+                    summary["rows"], summary["tables"],
                     (f", dropped shards {summary['dropped_shards']}"
                      if summary["dropped_shards"] else ""),
-                    ckpt_s,
+                    len(summary["cold_directory"]), ckpt_s,
                 )
             t1 = time.monotonic()
             last = self.store.recover(track_origin=dc_id)
@@ -181,13 +217,50 @@ class AntidoteNode:
                 "checkpoint + tail" if loaded is not None
                 else "full replay — no checkpoint found",
             )
+            if self.store.cold is not None \
+                    and self.store.cold.budget > 0:
+                # a beyond-RAM restart re-enforces the resident budget
+                # BEFORE serving: rows the installed image covers (and
+                # the tail left untouched) go straight back cold
+                n_ev = self.store.cold.enforce_budget()
+                if n_ev:
+                    rlog.info("recovery cold tier: %d row(s) re-evicted "
+                              "to the resident budget (%d)", n_ev,
+                              self.store.cold.budget)
         # react to replicated flag flips from ANY node in the DC
         # (registered last: construction-time get_env seeds fire watchers)
         self.meta.watch(self._on_meta_change)
 
+    # --- cold tier (ISSUE 13) -------------------------------------------
+    def enable_cold_tier(self, resident_rows: int = 0,
+                         fault_rate_cap: float = 0.0):
+        """Attach the cold tier: device residency bounded by
+        ``resident_rows`` (0 = unbounded; fault-in only), fault-ins past
+        ``fault_rate_cap``/s refused with a typed ColdMiss.  Requires a
+        durable log (the cold state lives in checkpoint sidecars)."""
+        if self.store.log is None:
+            raise RuntimeError("the cold tier requires log_dir (cold "
+                               "rows live in checkpoint sidecars)")
+        if self.store.cold is None:
+            from antidote_tpu.store.coldtier import ColdTier
+
+            self.store.cold = ColdTier(
+                self.store, budget=resident_rows,
+                fault_rate_cap=fault_rate_cap, lock=self.txm.commit_lock,
+            )
+            cp = self.checkpointer
+            if cp is not None:
+                self.store.cold.on_pressure = cp.request
+                self.store.cold.on_corrupt = cp._on_cold_corrupt
+        else:
+            self.store.cold.budget = int(resident_rows)
+            self.store.cold.fault_rate_cap = float(fault_rate_cap)
+        return self.store.cold
+
     # --- checkpointing (ISSUE 8) ----------------------------------------
     def start_checkpointer(self, interval_s: float = 300.0,
-                           retain: int = 2):
+                           retain: int = 2, rebase_every: int = 8,
+                           scrub_every_s: float = 0.0):
         """Attach (and, for ``interval_s`` > 0, start) the background
         checkpoint writer.  Requires a durable log.  Idempotent and
         race-safe: CHECKPOINT_NOW is served outside the wire dispatch
@@ -203,18 +276,22 @@ class AntidoteNode:
                 cp = Checkpointer(
                     self.store, self.txm, metrics=self.metrics,
                     interval_s=interval_s, retain=retain,
+                    rebase_every=rebase_every,
+                    scrub_every_s=scrub_every_s,
                 )
                 cp.extras_providers = self.checkpoint_extras_providers
                 cp.start()
                 self.checkpointer = cp
         return self.checkpointer
 
-    def checkpoint_now(self) -> dict:
+    def checkpoint_now(self, full: Optional[bool] = None) -> dict:
         """Run one synchronous checkpoint cycle (stamp, stream, publish,
-        reclaim); returns the published manifest summary."""
+        reclaim); returns the published manifest summary.  ``full``
+        forces a rebase (True) or a delta link (False); None lets the
+        chain cadence decide."""
         if self.checkpointer is None:
             self.start_checkpointer(interval_s=0.0)
-        return self.checkpointer.checkpoint_now()
+        return self.checkpointer.checkpoint_now(full=full)
 
     # --- readiness (wait_init, /root/reference/src/wait_init.erl:50-88) --
     def check_ready(self) -> dict:
@@ -359,6 +436,10 @@ class AntidoteNode:
                             time.time() - m.get("created_at", 0), 1),
                     })
                 out["checkpoint"] = blk
+        if self.store.cold is not None:
+            # cold tier (ISSUE 13): residency vs budget, fault/evict
+            # counters, anchor image — the beyond-RAM health view
+            out["cold_tier"] = self.store.cold.status()
         if include_ready:
             out["ready"] = self.check_ready()
         return out

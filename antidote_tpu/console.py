@@ -157,17 +157,25 @@ def cmd_serve(args) -> int:
         except ValueError as e:
             log(f"--mesh-devices {args.mesh_devices}: {e}")
             return 2
+    if args.resident_rows > 0 and args.log_dir is None:
+        log("--resident-rows requires --log-dir (cold rows live in "
+            "checkpoint sidecars)")
+        return 2
     recover = args.recover or has_wal_data
     node = AntidoteNode(cfg, dc_id=args.dc_id, log_dir=args.log_dir,
                         recover=recover,
                         sharding=mesh_plane.sharding
-                        if mesh_plane is not None else None)
+                        if mesh_plane is not None else None,
+                        resident_rows=args.resident_rows,
+                        cold_fault_rate_cap=args.cold_fault_rate_cap)
     if mesh_plane is not None:
         mesh_plane.metrics = node.metrics
         mesh_plane.attach(node.store)
     if args.log_dir is not None and args.checkpoint_interval_s > 0:
         node.start_checkpointer(interval_s=args.checkpoint_interval_s,
-                                retain=args.checkpoint_retain)
+                                retain=args.checkpoint_retain,
+                                rebase_every=args.checkpoint_rebase_every,
+                                scrub_every_s=args.checkpoint_scrub_s)
     probes = node.check_ready()
     if not all(probes.values()):
         log(f"NOT READY: {probes}")
@@ -672,6 +680,32 @@ def main(argv=None) -> int:
                          "reclaims WAL files below its floor, so restart "
                          "= load image + replay tail.  <= 0 disables "
                          "(restart then replays the whole WAL)")
+    sv.add_argument("--checkpoint-rebase-every", type=int, default=8,
+                    help="full-image rebase cadence of the incremental "
+                         "checkpoint chain (ISSUE 13): between rebases, "
+                         "a stamp writes only the rows dirtied since its "
+                         "parent link (cost tracks the write working "
+                         "set); the rebase re-bounds chain length and "
+                         "reclaimable WAL.  1 = always full (pre-chain "
+                         "behavior)")
+    sv.add_argument("--checkpoint-scrub-s", type=float, default=900.0,
+                    help="background bit-rot scrub cadence: CRC-verify "
+                         "retained images/links off the commit lock; a "
+                         "corrupt delta link is retired and a rebase "
+                         "forced (0 disables — bit rot is then only "
+                         "found at restart or follower bootstrap)")
+    sv.add_argument("--resident-rows", type=int, default=0,
+                    help="cold-tier device residency budget (ISSUE 13): "
+                         "past this many resident table rows, the "
+                         "coldest image-covered keys are evicted to the "
+                         "checkpoint sidecar and faulted back on read "
+                         "(typed cold_miss past the fault-rate cap).  "
+                         "0 = unbounded (cold tier armed only for "
+                         "fault-ins of an inherited beyond-RAM image)")
+    sv.add_argument("--cold-fault-rate-cap", type=float, default=0.0,
+                    help="cold fault-ins admitted per second before "
+                         "reads are refused with a typed cold_miss "
+                         "retry hint (0 = unlimited)")
     sv.add_argument("--checkpoint-retain", type=int, default=2,
                     help="published checkpoint images kept on disk; "
                          "older ones (and WAL files wholly below the "

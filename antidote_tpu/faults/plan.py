@@ -129,6 +129,18 @@ class FaultPlan:
                                                        fail the fetch —
                                                        the follower's
                                                        bootstrap retries)
+    coldtier.fault      tiered table name              cold-tier fault-in
+                                                       (ISSUE 13: delay
+                                                       holds the read
+                                                       mid-fault-in;
+                                                       error/io_error/
+                                                       enospc refuse it
+                                                       with a typed
+                                                       ColdMiss — never
+                                                       a wrong value,
+                                                       the client
+                                                       retries on the
+                                                       hint)
     native_pump.load    None                           native receive plane
     ==================  =============================  =================
     """

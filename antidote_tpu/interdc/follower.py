@@ -65,7 +65,7 @@ import numpy as np
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.interdc.messages import Descriptor
 from antidote_tpu.interdc.replica import DCReplica
-from antidote_tpu.store.kv import KVStore, freeze_key, key_to_shard, shard_digest
+from antidote_tpu.store.kv import KVStore, freeze_key, key_to_shard
 
 log = logging.getLogger(__name__)
 
@@ -245,6 +245,24 @@ class FollowerReplica(DCReplica):
                 self._in_heal = False
         self._post_apply_publish(force=True)
         self._send_report()
+        # --follower-peers sanity (ISSUE 13 satellite): ask the owner
+        # which origin lanes it actually carries; any lane we hold no
+        # descriptor for can never converge here — warn NOW, by name,
+        # instead of letting its divergence checks read as eternally
+        # "skipped"
+        try:
+            known = self.hub.request(self.owner_fid, "peer_origins", {})
+            missing = sorted(set(int(o) for o in known["origins"])
+                             - set(self.fleet_by_dc))
+            if missing:
+                log.warning(
+                    "follower %s: the owner replicates origin lane(s) %s "
+                    "but no descriptor for them was given — pass their "
+                    "endpoints via --follower-peers, or divergence "
+                    "checks on those lanes will report 'unsubscribed' "
+                    "forever", self.name, missing)
+        except Exception:
+            pass  # older owners without the peer_origins kind
         return mode
 
     def bootstrap(self) -> str:
@@ -375,23 +393,22 @@ class FollowerReplica(DCReplica):
         return self.hub.request(self.owner_fid if fid is None else fid,
                                 "ckpt_meta", body)
 
-    def _fetch_image(self, meta: dict,
-                     fid: Optional[int] = None) -> dict:
-        """Ship one member's image in chunks over the request channel
-        and verify size + CRC before decoding — a truncated or
+    def _fetch_file(self, meta: dict, fid: int, file: str,
+                    size: int, crc: int) -> bytes:
+        """Ship one published checkpoint file in chunks over the
+        request channel and verify size + CRC — a truncated or
         bit-rotted ship must fail loudly, never install."""
         import zlib
 
-        from antidote_tpu.store.handoff import unpack
-
-        fid = self.owner_fid if fid is None else fid
-        size = int(meta["image_bytes"])
         buf = bytearray()
         while len(buf) < size:
-            r = self.hub.request(fid, "ckpt_fetch", {
+            req = {
                 "id": int(meta["id"]), "off": len(buf),
                 "n": DCReplica.CKPT_SHIP_CHUNK,
-            })
+            }
+            if file != "image":
+                req["file"] = file
+            r = self.hub.request(fid, "ckpt_fetch", req)
             data = bytes(r["data"])
             if not data:
                 break
@@ -399,14 +416,36 @@ class FollowerReplica(DCReplica):
             if r.get("eof"):
                 break
         data = bytes(buf)
-        if (len(data) != size
-                or (zlib.crc32(data) & 0xFFFFFFFF)
-                != int(meta["image_crc32"])):
+        if len(data) != size or (zlib.crc32(data) & 0xFFFFFFFF) != crc:
             raise RuntimeError(
-                f"shipped checkpoint image ckpt_{meta['id']} failed "
+                f"shipped checkpoint {file} ckpt_{meta['id']} failed "
                 f"verification ({len(data)}/{size} bytes)"
             )
-        return unpack(data)
+        return data
+
+    def _fetch_image(self, meta: dict,
+                     fid: Optional[int] = None) -> dict:
+        """Ship one member's image (and, for a beyond-RAM owner, its
+        cold sidecar) and decode.  The sidecar bytes ride back on the
+        returned image dict under ``"_cold_bytes"`` — staged locally by
+        the reinstall so cold keys stay fault-able until the first
+        local rebase persists them."""
+        from antidote_tpu.store.handoff import unpack
+
+        fid = self.owner_fid if fid is None else fid
+        data = self._fetch_file(meta, fid, "image",
+                                int(meta["image_bytes"]),
+                                int(meta["image_crc32"]))
+        image = unpack(data)
+        if meta.get("cold_bytes") and meta.get("cold_keys"):
+            # only worth shipping when the image actually has cold keys
+            # (a budget-armed owner with everything resident publishes
+            # an image-sized sidecar the follower has no use for)
+            image["_cold_bytes"] = self._fetch_file(
+                meta, fid, "cold", int(meta["cold_bytes"]),
+                int(meta["cold_crc32"]))
+            image["_cold_manifest"] = meta.get("cold_manifest")
+        return image
 
     def _fetch_member_image(self, fid: int, meta: Optional[dict] = None):
         """Resolve + fetch one member's newest verifiable image with the
@@ -522,8 +561,38 @@ class FollowerReplica(DCReplica):
             txm.committed_keys = {}
             txm.commit_counter = 0
             txm.epoch_lag_counter = 0
-            for image, restrict in images:
-                install_image(store, txm, image, shards=restrict)
+            old_cold = old.cold
+            staged: List[str] = []
+            for idx, (image, restrict) in enumerate(images):
+                summary = install_image(store, txm, image,
+                                        shards=restrict)
+                cold_entries = summary.get("cold_directory") or []
+                if cold_entries:
+                    # beyond-RAM owner: stage the shipped sidecar so the
+                    # cold keys stay fault-able; the forced local REBASE
+                    # below re-emits them into our own image, after
+                    # which the staging file is swept
+                    if store.cold is None:
+                        budget = old_cold.budget if old_cold else 0
+                        cap = (old_cold.fault_rate_cap
+                               if old_cold else 0.0)
+                        self.node.enable_cold_tier(budget, cap)
+                    root = _ckpt.checkpoint_root(logm.dir)
+                    import os as _os
+
+                    _os.makedirs(root, exist_ok=True)
+                    token = f"import.{idx}.{image['id']}"
+                    path = _os.path.join(
+                        root, f"tmp.{_os.getpid()}.{token}.bin")
+                    with open(path, "wb") as f:
+                        f.write(image.pop("_cold_bytes"))
+                    staged.append(path)
+                    store.cold.add_source(token, path,
+                                          image.pop("_cold_manifest"))
+                    store.cold.seed(cold_entries, token)
+            if store.cold is None and old_cold is not None:
+                self.node.enable_cold_tier(old_cold.budget,
+                                           old_cold.fault_rate_cap)
             # follower floor fixup: the install stamped the OWNER's WAL
             # floors/seqs, but this WAL is freshly truncated — local
             # appends must mint q from 1 and local replay must skip
@@ -544,6 +613,15 @@ class FollowerReplica(DCReplica):
                         self.last_seen[(origin, shard)] = base
             self._sync_counter_locked()
         self._local_checkpoint()
+        for path in staged:
+            try:
+                import os as _os
+
+                _os.remove(path)  # reclaim-ok: staged import sidecar —
+                # the local rebase just re-emitted its rows into our own
+                # published image
+            except OSError:
+                pass
 
     def _local_checkpoint(self) -> None:
         """Checkpoint the freshly-installed state locally.  The node's
@@ -551,12 +629,14 @@ class FollowerReplica(DCReplica):
         against the new one, keeping its cadence."""
         node = self.node
         cp = node.checkpointer
-        interval, retain = 0.0, 2
+        interval, retain, rebase, scrub = 0.0, 2, 8, 0.0
         if cp is not None:
             interval, retain = cp.interval_s, cp.retain
+            rebase, scrub = cp.rebase_every, cp.scrub_every_s
             cp.stop()
             node.checkpointer = None
-        node.start_checkpointer(interval_s=interval, retain=retain)
+        node.start_checkpointer(interval_s=interval, retain=retain,
+                                rebase_every=rebase, scrub_every_s=scrub)
         node.checkpointer.checkpoint_now()
 
     # -- chain catch-up ---------------------------------------------------
@@ -730,16 +810,48 @@ class FollowerReplica(DCReplica):
             "to cover the session token (publish deferred)", dialect)
 
     # -- divergence detection ---------------------------------------------
+    def _lag_result(self, mine_vc, owner_vc, origins) -> str:
+        """Type a clock mismatch: ``unsubscribed`` when EVERY lane this
+        replica trails on is a peer lane it was never given a
+        descriptor for (--follower-peers), else ``skipped`` (replication
+        in flight — retried next sweep).  An unsubscribed lane can never
+        converge, so surfacing it typed (plus the attach-time warning)
+        is the difference between a misconfiguration and a permanently
+        green-looking check that never ran."""
+        behind = [l for l in range(self.node.cfg.max_dcs)
+                  if mine_vc[l] < owner_vc[l]]
+        if not behind:
+            return "skipped"  # ahead of the owner's cut: in-flight too
+        subscribed = set(self.fleet_by_dc)
+        origins = set(int(o) for o in (origins or []))
+        unsub = [l for l in behind if l in origins
+                 and l not in subscribed]
+        if unsub and len(unsub) == len(behind):
+            now = time.monotonic()
+            if now - getattr(self, "_last_unsub_warn", 0.0) > 10.0:
+                self._last_unsub_warn = now
+                log.warning(
+                    "follower %s: divergence checks trail on peer "
+                    "lane(s) %s that this follower is NOT subscribed to "
+                    "— pass the peer DC endpoint(s) via --follower-peers "
+                    "or these checks can never converge", self.name,
+                    unsub)
+            return "unsubscribed"
+        return "skipped"
+
     def check_divergence(self, shards=None) -> Dict[int, str]:
-        """Compare per-shard content digests against the owner at EQUAL
+        """Compare per-shard Merkle roots against the owner at EQUAL
         applied clocks — each shard against WHICHEVER member owns it at
         the compared clock (the gossip-learned route; a mid-fleet shard
         move re-points the comparison with no reconnect).  ``skipped`` =
-        clocks unequal (replication in flight — nothing comparable,
-        retried next sweep); ``ok`` = digests match; ``mismatch`` =
-        silent corruption — the follower quarantines itself and
-        re-bootstraps from the fleet's images before serving another
-        session read."""
+        clocks unequal (replication in flight — retried next sweep);
+        ``unsubscribed`` = the lag is on a peer lane this follower has
+        no descriptor for (typed misconfiguration, never silent);
+        ``ok`` = roots match; ``mismatch`` = silent corruption — the
+        follower quarantines, walks the tree in O(log n) hash
+        comparisons to localize the diverged leaf range, and heals by
+        fetching ONLY that range; a full image re-bootstrap remains the
+        escalation when the range heal cannot converge."""
         m = getattr(self.node, "metrics", None)
         out: Dict[int, str] = {}
         for shard in (range(self.node.cfg.n_shards)
@@ -747,22 +859,30 @@ class FollowerReplica(DCReplica):
             shard = int(shard)
             try:
                 reply = self.hub.request(
-                    self._route(self.dc_id, shard), "shard_digest",
+                    self._route(self.dc_id, shard), "merkle_root",
                     {"shard": shard})
             except Exception as e:
                 log.warning("follower %s: divergence check for shard %d "
                             "unreachable (%r)", self.name, shard, e)
                 out[shard] = "unreachable"
                 continue
+            from antidote_tpu.store.merkle import get_merkle
+
             store = self.node.store
             with self.node.txm.commit_lock:
                 mine_vc = [int(x) for x in store.applied_vc[shard]]
                 if mine_vc != [int(x) for x in reply["vc"]]:
-                    result = "skipped"
+                    result = self._lag_result(mine_vc, reply["vc"],
+                                              reply.get("origins"))
                     mine = None
                 else:
-                    mine = shard_digest(store, shard)
-                    result = ("ok" if mine == reply["digest"]
+                    mk = get_merkle(store)
+                    # detection must re-read the data (corruption
+                    # bypasses the incremental marks); the walk and the
+                    # leaf heal then reuse these fresh leaf hashes
+                    mk.rescan(shard)
+                    mine = mk.root(shard)
+                    result = ("ok" if mine == reply["root"]
                               else "mismatch")
             self.divergence_counts[result] = (
                 self.divergence_counts.get(result, 0) + 1)
@@ -772,13 +892,209 @@ class FollowerReplica(DCReplica):
             if result == "mismatch":
                 log.error(
                     "follower %s DIVERGED from the owner on shard %d at "
-                    "applied clock %s (digest %s != %s): quarantining "
-                    "and re-bootstrapping from the checkpoint image",
-                    self.name, shard, mine_vc, mine, reply["digest"],
+                    "applied clock %s (root %s != %s): quarantining and "
+                    "healing the localized range",
+                    self.name, shard, mine_vc, mine, reply["root"],
                 )
-                self._heal("image")
-                break
+                if not self._merkle_heal(shard):
+                    log.error(
+                        "follower %s: range heal for shard %d could not "
+                        "converge; escalating to a full image "
+                        "re-bootstrap", self.name, shard)
+                    if m is not None:
+                        m.divergence_heals.inc(mode="image")
+                    self._heal("image")
+                    break
         return out
+
+    #: range-heal convergence attempts before escalating to a full
+    #: image re-bootstrap (each attempt re-pins equal clocks)
+    MERKLE_HEAL_ATTEMPTS = 8
+
+    def _merkle_heal(self, shard: int) -> bool:
+        """Localize + repair one shard's divergence: walk the owner's
+        tree against ours (O(fanout·depth) hash comparisons per
+        diverged leaf), fetch ONLY the diverged leaves' key states, and
+        install them at equal applied clocks.  Quarantines for the
+        duration (sessions get typed redirects), never wipes the store.
+        Returns True once the roots agree again."""
+        from antidote_tpu.store.merkle import get_merkle
+
+        m = getattr(self.node, "metrics", None)
+        prev_state, self.state = self.state, "healing"
+        try:
+            for _attempt in range(self.MERKLE_HEAL_ATTEMPTS):
+                # re-resolve per attempt: a live shard move mid-heal
+                # re-points at the new owning member (same discipline
+                # as the sweep itself)
+                target = self._route(self.dc_id, shard)
+                store = self.node.store
+                mk = get_merkle(store)
+                try:
+                    root = self.hub.request(target, "merkle_root",
+                                            {"shard": shard})
+                except Exception:
+                    self._on_clock_wait()
+                    continue
+                try:
+                    with self.node.txm.commit_lock:
+                        mine_vc = [int(x)
+                                   for x in store.applied_vc[shard]]
+                        if mine_vc != [int(x) for x in root["vc"]]:
+                            pass  # clocks moved: drain + retry below
+                        elif mk.root(shard) == root["root"]:
+                            if m is not None:
+                                m.divergence_heals.inc(mode="range")
+                            self._seal_heal(shard)
+                            return True
+                        else:
+                            leaves = self._walk_diverged(
+                                mk, shard, target, mine_vc)
+                            if leaves is not None:
+                                healed = all(
+                                    self._heal_leaf(mk, shard, target,
+                                                    leaf, mine_vc)
+                                    for leaf in leaves)
+                                if healed \
+                                        and mk.root(shard) == root["root"]:
+                                    if m is not None:
+                                        m.divergence_heals.inc(
+                                            mode="range")
+                                    self._seal_heal(shard)
+                                    return True
+                except Exception:
+                    # a mid-walk owner restart / cold-tier refusal must
+                    # not crash the pump tick this check runs on: count
+                    # the attempt and retry (escalating to the image
+                    # re-bootstrap when the attempts run out)
+                    log.warning(
+                        "follower %s: merkle heal attempt for shard %d "
+                        "failed mid-walk; retrying", self.name, shard,
+                        exc_info=True)
+                self._on_clock_wait()
+            return False
+        finally:
+            if self.state == "healing":
+                self.state = prev_state
+
+    def _seal_heal(self, shard: int) -> None:
+        """Make a range heal DURABLE: the corrupt bytes may already sit
+        in a published image/link, and a delta cannot represent the
+        phantom-row drops — force the next stamp to be a full rebase so
+        a restart composes the healed state, not the diverged one."""
+        cp = self.node.checkpointer
+        if cp is not None:
+            cp.force_rebase = True
+            cp.request()
+
+    def _walk_diverged(self, mk, shard: int, target: int, pin_vc):
+        """Descend the tree from the root, following mismatching
+        children only.  Returns the diverged leaf indices, or None when
+        the owner's clock moved mid-walk (caller retries).  Runs under
+        the commit lock (the local leaves must stay one cut)."""
+        m = getattr(self.node, "metrics", None)
+        frontier = [(0, 0)]
+        depth = mk.depth()
+        for level in range(depth):
+            nxt = []
+            for _lv, idx in frontier:
+                reply = self.hub.request(target, "merkle_node", {
+                    "shard": shard, "level": level, "index": idx})
+                if [int(x) for x in reply["vc"]] != pin_vc:
+                    return None  # owner moved on: retry the attempt
+                mine = mk.children(shard, level, idx)
+                if m is not None:
+                    m.merkle_probe_hashes.inc(len(mine))
+                for child, (a, b) in enumerate(zip(mine,
+                                                   reply["hashes"])):
+                    if a != b:
+                        nxt.append((level + 1,
+                                    idx * mk.fanout + child))
+            frontier = nxt
+            if not frontier:
+                return []
+        return [idx for _lv, idx in frontier]
+
+    def _heal_leaf(self, mk, shard: int, target: int, leaf: int,
+                   pin_vc) -> bool:
+        """Replace one leaf's keys with the owner's states — the
+        range-restricted fetch.  Runs under the commit lock; verifies
+        the owner served the SAME applied cut (else a chain op could
+        later double-apply over a newer head)."""
+        reply = self.hub.request(target, "merkle_leaf",
+                                 {"shard": shard, "leaf": leaf})
+        if [int(x) for x in reply["vc"]] != pin_vc:
+            return False
+        store = self.node.store
+        shipped = set()
+        for key, bucket, tname, slots_ub, head_vc, heads in reply["keys"]:
+            key = freeze_key(key)
+            dk = (key, bucket)
+            shipped.add(dk)
+            self._install_healed_row(store, dk, tname, slots_ub,
+                                     head_vc, heads)
+            mk.mark(shard, dk)
+        # keys we hold in this leaf that the owner does not: phantom
+        # rows from the corruption — drop them (typed absence beats a
+        # resurrecting ghost)
+        for dk in mk.leaf_keys(shard, leaf) - shipped:
+            ent = store.directory.get(dk)
+            if ent is not None:
+                t = store.table(ent[0])
+                t.evict_rows(np.asarray([ent[1]]),  # evict-ok: Merkle
+                             np.asarray([ent[2]]))  # range heal drops a
+                # phantom row the owner's leaf does not contain
+                store.directory.pop(dk, None)
+            if store.cold is not None:
+                store.cold.cold_set.discard(dk)
+                store.cold.refs.pop(dk, None)
+                s = store.cold.by_shard.get(shard)
+                if s is not None:
+                    s.discard(dk)
+            store.drop_cached_value(dk)
+            store.mark_epoch_fallback(dk)
+            mk.mark(shard, dk)
+        return True
+
+    def _install_healed_row(self, store, dk, tname: str, slots_ub: int,
+                            head_vc, heads) -> None:
+        """Install one shipped key state: clear any existing row (even
+        at another slot tier — promotion timing differs legitimately
+        between replicas), then alloc + head install with a seeded
+        snapshot version (same discipline as a cold fault-in)."""
+        ent = store.directory.get(dk)
+        if ent is None and store.cold is not None \
+                and store.cold.is_cold(dk):
+            # cold here, diverged at the owner: drop the cold ref and
+            # install resident — the next rebase re-covers it
+            ref = store.cold.refs.pop(dk, None)
+            store.cold.cold_set.discard(dk)
+            if ref is not None:
+                s = store.cold.by_shard.get(ref.shard)
+                if s is not None:
+                    s.discard(dk)
+        if ent is not None:
+            t_old = store.table(ent[0])
+            t_old.evict_rows(np.asarray([ent[1]]),  # evict-ok: Merkle
+                             np.asarray([ent[2]]))  # range heal replaces
+            # the (possibly corrupt) row with the owner's shipped state
+            store.directory.pop(dk, None)
+        t = store.table(tname)
+        shard = int(ent[1]) if ent is not None else key_to_shard(
+            dk[0], dk[1], store.cfg.n_shards)
+        row = t.alloc_row(shard)
+        head_rows = {}
+        for f, spec in heads.items():
+            head_rows[f] = np.frombuffer(
+                spec["b"], np.dtype(spec["dt"])).reshape(
+                spec["sh"])[None]
+        t.install_rows(np.asarray([shard]), np.asarray([row]), head_rows,
+                       np.asarray(head_vc, np.int32)[None])
+        t.slots_ub[shard, row] = int(slots_ub)
+        store.directory[dk] = (tname, shard, row)
+        store.note_ckpt_dirty(dk)  # delta links must carry the heal
+        store.drop_cached_value(dk)
+        store.mark_epoch_fallback(dk)
 
     # -- liveness / status -------------------------------------------------
     def _send_report(self) -> None:

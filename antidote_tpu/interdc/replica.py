@@ -586,6 +586,15 @@ class DCReplica:
             return self._serve_ckpt_fetch(payload)
         if kind == "shard_digest":
             return self._serve_shard_digest(payload)
+        # Merkle-split divergence plane (ISSUE 13)
+        if kind == "merkle_root":
+            return self._serve_merkle_root(payload)
+        if kind == "merkle_node":
+            return self._serve_merkle_node(payload)
+        if kind == "merkle_leaf":
+            return self._serve_merkle_leaf(payload)
+        if kind == "peer_origins":
+            return self._serve_peer_origins()
         if kind == "follower_report":
             return self._serve_follower_report(payload)
         raise ValueError(f"unknown request kind {kind!r}")
@@ -713,7 +722,13 @@ class DCReplica:
             elif d.action in ("error", "io_error", "enospc"):
                 raise OSError(_errno.EIO,
                               f"injected fault: ckpt.ship ckpt_{ckpt_id}")
-        path = _ckpt.image_path(wlog.dir, ckpt_id)
+        if payload.get("file") == "cold":
+            # the cold sidecar of a beyond-RAM owner: a follower must
+            # ship it alongside the image (its cold keys' state lives
+            # only there)
+            path = _ckpt.cold_path(wlog.dir, ckpt_id)
+        else:
+            path = _ckpt.image_path(wlog.dir, ckpt_id)
         off = int(payload.get("off", 0))
         n = int(payload.get("n", self.CKPT_SHIP_CHUNK))
         with open(path, "rb") as f:
@@ -735,6 +750,90 @@ class DCReplica:
             return {
                 "vc": [int(x) for x in store.applied_vc[shard]],
                 "digest": shard_digest(store, shard),
+                "origins": self._known_origins(),
+            }
+
+    def _known_origins(self) -> List[int]:
+        """Origin dc lanes this endpoint actually carries chains for —
+        the follower's evidence for typing a lag as ``unsubscribed``
+        (it was never given that peer's descriptor) instead of
+        indefinitely ``skipped``."""
+        return sorted({o for (o, _s) in self.last_seen} | {self.dc_id})
+
+    def _serve_peer_origins(self) -> dict:
+        return {"origins": self._known_origins()}
+
+    def _serve_merkle_root(self, payload) -> dict:
+        """One shard's Merkle root at its applied clock (ISSUE 13): the
+        O(1) comparison a follower starts a divergence check with; the
+        walk descends through ``merkle_node`` only on a mismatch."""
+        from antidote_tpu.store.merkle import get_merkle
+
+        shard = int(payload["shard"])
+        store = self.node.store
+        with self.node.txm.commit_lock:
+            mk = get_merkle(store)
+            # a root served for divergence detection must re-read the
+            # data: corruption bypasses the incremental marks
+            mk.rescan(shard)
+            return {
+                "vc": [int(x) for x in store.applied_vc[shard]],
+                "root": mk.root(shard),
+                "leaves": mk.n_leaves,
+                "fanout": mk.fanout,
+                "depth": mk.depth(),
+                "origins": self._known_origins(),
+            }
+
+    def _serve_merkle_node(self, payload) -> dict:
+        """Child hashes of one tree node — the O(log n) walk step."""
+        from antidote_tpu.store.merkle import get_merkle
+
+        shard = int(payload["shard"])
+        store = self.node.store
+        with self.node.txm.commit_lock:
+            mk = get_merkle(store)
+            return {
+                "vc": [int(x) for x in store.applied_vc[shard]],
+                "hashes": mk.children(shard, int(payload["level"]),
+                                      int(payload["index"])),
+            }
+
+    def _serve_merkle_leaf(self, payload) -> dict:
+        """One leaf's raw key states — the range-restricted heal fetch:
+        the follower replaces EXACTLY the diverged leaf's rows instead
+        of re-installing the whole store.  Served under the commit lock
+        so the states are one cut with the returned clock."""
+        from antidote_tpu.store.merkle import get_merkle
+
+        shard = int(payload["shard"])
+        leaf = int(payload["leaf"])
+        store = self.node.store
+        with self.node.txm.commit_lock:
+            mk = get_merkle(store)
+            rows = []
+            for key, bucket in sorted(mk.leaf_keys(shard, leaf), key=repr):
+                ent = store.directory.get((key, bucket))
+                if ent is None and store.cold is not None \
+                        and store.cold.is_cold((key, bucket)):
+                    ent = store.cold.fault_in((key, bucket), admit=False)
+                if ent is None:
+                    continue
+                tname, _s, row = ent
+                t = store.table(tname)
+                heads = {}
+                for f, x in t.head.items():
+                    arr = np.asarray(x[shard, row])
+                    heads[f] = {"b": arr.tobytes(), "dt": str(arr.dtype),
+                                "sh": list(arr.shape)}
+                rows.append([
+                    key, bucket, tname, int(t.slots_ub[shard, row]),
+                    [int(v) for v in np.asarray(t.head_vc[shard, row])],
+                    heads,
+                ])
+            return {
+                "vc": [int(x) for x in store.applied_vc[shard]],
+                "keys": rows,
             }
 
     def _serve_follower_report(self, payload) -> dict:

@@ -111,35 +111,87 @@ def load_manifest(path: str) -> Optional[dict]:
         return None
 
 
-def load_latest(log_dir: str) -> Optional[Tuple[dict, dict]]:
-    """Newest checkpoint whose image verifies (size + CRC against its
-    manifest), or None.  A corrupt newest image falls back to the next
-    older one — the retention window is the recovery safety margin."""
+def manifest_kind(manifest: dict) -> str:
+    """"full" (whole-store image, possibly with a cold sidecar) or
+    "delta" (parent-linked incremental link).  Pre-chain manifests carry
+    no kind and are full images."""
+    return str(manifest.get("kind", "full"))
+
+
+def _load_verified(path: str, manifest: dict) -> Optional[dict]:
+    """Read + CRC-verify + decode one published image/link, or None."""
     from antidote_tpu.store.handoff import unpack
 
+    try:
+        with open(os.path.join(path, _IMAGE), "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if (len(data) != int(manifest.get("image_bytes", -1))
+            or (zlib.crc32(data) & 0xFFFFFFFF)
+            != int(manifest.get("image_crc32", -1))):
+        return None
+    try:
+        return unpack(data)
+    except Exception:
+        return None
+
+
+def load_latest(log_dir: str) -> Optional[Tuple[dict, dict]]:
+    """Newest FULL checkpoint whose image verifies (size + CRC against
+    its manifest), or None.  A corrupt newest image falls back to the
+    next older one — the retention window is the recovery safety margin.
+    Delta links are skipped here; :func:`load_chain` composes them."""
     for id_, path in reversed(list_checkpoints(checkpoint_root(log_dir))):
         manifest = load_manifest(path)
-        if manifest is None:
+        if manifest is None or manifest_kind(manifest) != "full":
             continue
-        try:
-            with open(os.path.join(path, _IMAGE), "rb") as f:
-                data = f.read()
-        except OSError:
-            continue
-        if (len(data) != int(manifest.get("image_bytes", -1))
-                or (zlib.crc32(data) & 0xFFFFFFFF)
-                != int(manifest.get("image_crc32", -1))):
+        image = _load_verified(path, manifest)
+        if image is None:
             log.warning("checkpoint %s fails verification; falling back "
                         "to an older image", path)
             continue
-        try:
-            image = unpack(data)
-        except Exception:
-            log.warning("checkpoint %s image undecodable; falling back",
-                        path)
-            continue
         return image, manifest
     return None
+
+
+def load_chain(log_dir: str) -> Optional[Tuple[dict, dict, List[Tuple[dict, dict]]]]:
+    """The recovery composition (ISSUE 13 incremental chains): the
+    newest verifiable FULL image plus every parent-linked, CRC-verified
+    delta link published after it, in apply order.  The chain STOPS at
+    the first missing / corrupt / mis-linked delta — recovery then falls
+    back to the last good prefix + a longer WAL tail (reclaim never
+    deletes records above the retained full images' floors, so the tail
+    is always still on disk).  Returns (image, manifest, deltas) or
+    None when nothing full is published."""
+    base = load_latest(log_dir)
+    if base is None:
+        return None
+    image, manifest = base
+    deltas: List[Tuple[dict, dict]] = []
+    prev_id = int(manifest["id"])
+    for id_, path in list_checkpoints(checkpoint_root(log_dir)):
+        if id_ <= prev_id:
+            continue
+        man = load_manifest(path)
+        if man is None or manifest_kind(man) != "delta":
+            continue
+        if int(man.get("parent", -1)) != (int(deltas[-1][1]["id"])
+                                          if deltas else prev_id):
+            log.warning(
+                "checkpoint chain broken at link %d (parent %s does not "
+                "match the chain head); recovering from the prefix + a "
+                "longer WAL tail", id_, man.get("parent"))
+            break
+        delta = _load_verified(path, man)
+        if delta is None:
+            log.warning(
+                "checkpoint chain link %d fails verification (bit rot / "
+                "torn write); recovering from the prefix + a longer WAL "
+                "tail", id_)
+            break
+        deltas.append((delta, man))
+    return image, manifest, deltas
 
 
 def latest_image_meta(log_dir: str,
@@ -156,15 +208,27 @@ def latest_image_meta(log_dir: str,
         if before_id is not None and _id >= int(before_id):
             continue
         manifest = load_manifest(path)
-        if manifest is None:
-            continue
-        return {
+        if manifest is None or manifest_kind(manifest) != "full":
+            continue  # delta links are not shippable on their own
+        out = {
             "id": int(manifest["id"]),
             "image_bytes": int(manifest["image_bytes"]),
             "image_crc32": int(manifest["image_crc32"]),
             "stamp_vc_max": manifest.get("stamp_vc_max"),
             "created_at": manifest.get("created_at"),
         }
+        cold = manifest.get("cold")
+        if cold is not None:
+            # a beyond-RAM owner: the follower must fetch the sidecar
+            # too — but only when the image actually has cold keys (the
+            # sidecar also exists, image-sized, on a budget-armed owner
+            # with everything resident; shipping it then would double
+            # the bootstrap transfer for nothing)
+            out["cold_keys"] = int(manifest.get("cold_keys", 0))
+            out["cold_bytes"] = int(cold["bytes"])
+            out["cold_crc32"] = int(cold["crc32"])
+            out["cold_manifest"] = cold
+        return out
     return None
 
 
@@ -172,6 +236,14 @@ def image_path(log_dir: str, ckpt_id: int) -> str:
     """Path of a published image file by id (ckpt_fetch serving)."""
     return os.path.join(checkpoint_root(log_dir), f"ckpt_{int(ckpt_id)}",
                         _IMAGE)
+
+
+def cold_path(log_dir: str, ckpt_id: int) -> str:
+    """Path of a published cold sidecar by id (ckpt_fetch file="cold")."""
+    from antidote_tpu.store.coldtier import COLD_BIN
+
+    return os.path.join(checkpoint_root(log_dir), f"ckpt_{int(ckpt_id)}",
+                        COLD_BIN)
 
 
 def discard_all(log_dir: str) -> int:
@@ -390,6 +462,19 @@ def install_image(store, txm, image: dict, shards=None) -> dict:
         fl[rlist] = floors[rlist]
         ch[rlist] = chains[rlist]
         logm.set_floor(fl, ch)
+    # cold keys (ISSUE 13): the image's cold_directory names keys whose
+    # state lives ONLY in the cold sidecar — they get NO device row here
+    # (that is the whole point: recovery of a beyond-RAM store installs
+    # the bounded resident set); the caller registers them with its
+    # ColdTier so reads fault them in on demand
+    cold_entries = []
+    for ent in image.get("cold_directory", []) or []:
+        s = int(ent[3])
+        if s in stale_set:
+            continue
+        if rlist is not None and s not in set(rlist):
+            continue
+        cold_entries.append(ent)
     committed = image.get("committed_keys", [])
     if committed and not stale_set and rlist is None \
             and not txm.committed_keys:
@@ -413,6 +498,138 @@ def install_image(store, txm, image: dict, shards=None) -> dict:
         "tables": len(image["tables"]),
         "dropped_shards": stale,
         "restricted_to": rlist,
+        "cold_directory": cold_entries,
+    }
+
+
+def install_delta(store, txm, delta: dict) -> dict:
+    """Overlay one incremental chain link onto an already-installed
+    parent state (recovery composition, ISSUE 13): scatter the link's
+    dirty rows' heads into the tables (seeding one snapshot version per
+    row, exactly like :func:`install_image`), apply the directory /
+    certification / blob deltas, re-register keys the link records as
+    EVICTED, and advance floors, op-id chains and clocks to the link's
+    stamp.  Returns a summary dict."""
+    from antidote_tpu.store.kv import freeze_key
+
+    logm = store.log
+    assert logm is not None, "delta install needs the durable log"
+    cfg = store.cfg
+    if (int(delta["n_shards"]) != cfg.n_shards
+            or int(delta["max_dcs"]) != cfg.max_dcs):
+        raise CheckpointError(
+            f"chain link shape (n_shards={delta['n_shards']}) does not "
+            f"match the deployment ({cfg.n_shards})")
+    delta_resets = {int(k): int(v)
+                    for k, v in (delta.get("shard_resets") or {}).items()}
+    stale = {
+        s for s in range(cfg.n_shards)
+        if logm.shard_resets.get(s, 0) > delta_resets.get(s, 0)
+    }
+    # evictions FIRST: the rows this link records as evicted were freed
+    # and may be REUSED by the link's own row overlays below — clearing
+    # them after the overlay would wipe the new tenants' state
+    evicted = [e for e in delta.get("cold_delta", [])
+               if int(e[3]) not in stale]
+    if evicted and store.cold is None:
+        # the chain recorded evictions but this boot has no cold tier
+        # (restarted without --resident-rows): attach one anyway —
+        # dropping the keys' directory entries without registering their
+        # sidecar refs would turn their reads into silent bottoms
+        from antidote_tpu.store.coldtier import ColdTier
+
+        store.cold = ColdTier(store, budget=0,
+                              lock=getattr(txm, "commit_lock", None))
+    for key, bucket, tname, shard, _srow in evicted:
+        dk = (freeze_key(key), bucket)
+        ent = store.directory.get(dk)
+        if ent is not None:
+            t = store.table(ent[0])
+            t.evict_rows(np.asarray([ent[1]]),  # evict-ok: composing a
+                         np.asarray([ent[2]]))  # recorded cold-tier
+            # eviction from the chain link — the sidecar coords ride in
+            # the same entry and are re-registered just below
+            store.directory.pop(dk, None)
+    if store.cold is not None and evicted:
+        src = delta.get("cold_src")
+        store.cold.seed([[e[0], e[1], e[2], e[3], e[4]] for e in evicted],
+                        src if src is not None else delta.get("parent"))
+    n_rows = 0
+    for tname, tb in delta["tables"].items():
+        t = store.table(tname)
+        pairs = [(int(s), int(r)) for s, r in tb["rows"]
+                 if int(s) not in stale]
+        if not pairs:
+            continue
+        keep = np.asarray([int(s) not in stale
+                           for s, _ in tb["rows"]], bool)
+        ss = np.asarray([p[0] for p in pairs], np.int64)
+        rr = np.asarray([p[1] for p in pairs], np.int64)
+        while int(rr.max()) >= t.n_rows:
+            t._grow()
+        head_rows = {f: np.asarray(x)[keep] for f, x in tb["head"].items()}
+        hvc_rows = np.asarray(tb["head_vc"], np.int32)[keep]
+        t.install_rows(ss, rr, head_rows, hvc_rows)
+        # overlaid rows are OCCUPIED now: pull them off the free lists
+        # the eviction pass above may have pushed them onto (a later
+        # alloc_row handing one out again would double-bind the row)
+        occupied: Dict[int, set] = {}
+        for s, r in pairs:
+            occupied.setdefault(s, set()).add(r)
+        for s, rows_set in occupied.items():
+            free = t.free_rows.get(s)
+            if free:
+                t.free_rows[s] = [r for r in free if r not in rows_set]
+        t.slots_ub[ss, rr] = np.asarray(tb["slots_ub"], np.int32)[keep]
+        used = np.asarray(tb["used_rows"], np.int64)
+        for s in stale:
+            used[s] = 0
+        np.maximum(t.used_rows, used, out=t.used_rows)
+        t.max_abs_delta = max(t.max_abs_delta, int(tb["max_abs_delta"]))
+        np.maximum(t.max_commit_vc,
+                   np.asarray(tb["max_commit_vc"], np.int32),
+                   out=t.max_commit_vc)
+        n_rows += len(pairs)
+    for key, bucket, tname, shard, row in delta.get("directory_delta", []):
+        if int(shard) in stale:
+            continue
+        dk = (freeze_key(key), bucket)
+        store.directory[dk] = (tname, int(shard), int(row))
+        if store.cold is not None and store.cold.is_cold(dk):
+            # the link proves the key resident at its stamp: undo the
+            # cold registration an earlier full install seeded
+            store.cold.cold_set.discard(dk)
+            s = store.cold.by_shard.get(int(shard))
+            if s is not None:
+                s.discard(dk)
+    for key, bucket, counter in delta.get("committed_delta", []):
+        dk = (freeze_key(key), bucket)
+        txm.committed_keys[dk] = max(txm.committed_keys.get(dk, 0),
+                                     int(counter))
+    for h, data in delta.get("blobs_delta", []):
+        store.blobs.intern_bytes(int(h), bytes(data))
+    for s, hashes in enumerate(delta.get("blob_seen", [])):
+        if s < cfg.n_shards and s not in stale:
+            logm._blob_seen[s] = {int(h) for h in hashes}
+    floors = np.asarray(delta["floor_seqs"], np.int64).copy()
+    chains = np.asarray(delta["chain_floor"], np.int64).copy()
+    stamp = np.asarray(delta["stamp_vc"], np.int32).copy()
+    op_ids = np.asarray(delta["op_ids"], np.int64).copy()
+    for s in stale:
+        floors[s] = logm.floor_seqs[s]
+        chains[s] = logm.chain_floor[s]
+        stamp[s] = 0
+        op_ids[s] = 0
+    np.maximum(store.applied_vc, stamp, out=store.applied_vc)
+    np.maximum(logm.op_ids, op_ids, out=logm.op_ids)
+    logm.set_floor(floors, chains)
+    return {
+        "id": int(delta["id"]),
+        "parent": int(delta["parent"]),
+        "rows": n_rows,
+        "keys": len(delta.get("directory_delta", [])),
+        "evicted": len(evicted),
+        "dropped_shards": sorted(stale),
     }
 
 
@@ -481,14 +698,37 @@ class Checkpointer:
     """
 
     def __init__(self, store, txm, metrics=None, interval_s: float = 300.0,
-                 retain: int = 2):
+                 retain: int = 2, rebase_every: int = 8,
+                 scrub_every_s: float = 0.0):
         assert store.log is not None, "checkpointing needs a durable log"
         self.store = store
         self.txm = txm
         self.log = store.log
         self.metrics = metrics
         self.interval_s = float(interval_s)
+        #: FULL images retained (delta links between them ride along;
+        #: links below the newest full are swept — the rebase covers them)
         self.retain = max(1, int(retain))
+        #: delta links between full rebases: a stamp writes only the
+        #: rows/keys dirtied since its parent (cost ∝ dirty set), and
+        #: every ``rebase_every``-th stamp is a full image that re-bounds
+        #: both the chain length and the reclaimable WAL.  0/1 = always
+        #: full (the pre-chain behavior).
+        self.rebase_every = max(0, int(rebase_every))
+        #: background bit-rot scrub cadence (0 = disabled): CRC-verify
+        #: retained images + links off the commit lock; a failed scrub
+        #: retires a delta link and forces a rebase
+        self.scrub_every_s = float(scrub_every_s)
+        #: bytes/second ceiling for scrub reads (never starve the WAL)
+        self.scrub_bps = 64 << 20
+        #: the next stamp must be a FULL rebase (set by a failed stamp —
+        #: the consumed dirty windows are unrecoverable — by a corrupt
+        #: fault-in/scrub, and by cold-tier pressure)
+        self.force_rebase = False
+        #: delta links since the last full image (chain length)
+        self.chain_len = 0
+        self.scrub_counts = {"ok": 0, "corrupt": 0}
+        self._last_scrub = 0.0
         self.root = checkpoint_root(self.log.dir)
         #: name -> callable returning a msgpack-able blob captured under
         #: the commit lock (cluster membership, embedder state, ...)
@@ -512,6 +752,26 @@ class Checkpointer:
         if cks:
             self._next_id = cks[-1][0] + 1
             self.last = load_manifest(cks[-1][1])
+            # resume the chain position: links since the newest full
+            self.chain_len = 0
+            for _id, path in cks:
+                m = load_manifest(path)
+                if m is None:
+                    continue
+                if manifest_kind(m) == "full":
+                    self.chain_len = 0
+                else:
+                    self.chain_len += 1
+        if self.store.cold is not None:
+            # cold-tier integration: budget pressure nudges a stamp;
+            # fault-in CRC failures force a rebase (re-reads every row,
+            # tombstones the truly lost ones)
+            self.store.cold.on_pressure = self.request
+            self.store.cold.on_corrupt = self._on_cold_corrupt
+
+    def _on_cold_corrupt(self) -> None:
+        self.force_rebase = True
+        self._wake.set()
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "Checkpointer":
@@ -535,18 +795,39 @@ class Checkpointer:
             th.join(timeout=30)
 
     def _loop(self) -> None:
+        # two independent cadences share the loop: the scrub must keep
+        # its own (usually faster-or-slower) rhythm even when the
+        # checkpoint interval is long — waking never stamps early, a
+        # stamp is taken only when ITS deadline (or a request) is due
+        last_ckpt = time.monotonic()
+        self._last_scrub = time.monotonic()
         while not self._stop:
-            self._wake.wait(timeout=self.interval_s)
+            wait = self.interval_s
+            if self.scrub_every_s > 0:
+                wait = min(wait, self.scrub_every_s)
+            woke = self._wake.wait(timeout=wait)
             self._wake.clear()
             if self._stop:
                 return
-            try:
-                self.checkpoint_now()
-            except CheckpointError as e:
-                log.warning("periodic checkpoint failed (will retry on "
-                            "the next interval): %s", e)
-            except Exception:
-                log.exception("periodic checkpoint failed unexpectedly")
+            now = time.monotonic()
+            if woke or now - last_ckpt >= self.interval_s:
+                last_ckpt = now
+                try:
+                    self.checkpoint_now()
+                except CheckpointError as e:
+                    log.warning("periodic checkpoint failed (will retry "
+                                "on the next interval): %s", e)
+                except Exception:
+                    log.exception("periodic checkpoint failed "
+                                  "unexpectedly")
+            if (self.scrub_every_s > 0 and not self._stop
+                    and time.monotonic() - self._last_scrub
+                    >= self.scrub_every_s):
+                self._last_scrub = time.monotonic()
+                try:
+                    self.scrub()
+                except Exception:
+                    log.exception("checkpoint scrub pass failed")
 
     # -- observability --------------------------------------------------
     def status(self) -> dict:
@@ -554,6 +835,9 @@ class Checkpointer:
         out = {
             "interval_s": self.interval_s,
             "retain": self.retain,
+            "rebase_every": self.rebase_every,
+            "chain_len": self.chain_len,
+            "scrub": dict(self.scrub_counts),
             "reclaimed_bytes_total": self.reclaimed_total,
             "tail_records": int(
                 (self.log.seqs - self.log.floor_seqs).sum()),
@@ -570,16 +854,79 @@ class Checkpointer:
         return out
 
     # -- the cycle ------------------------------------------------------
-    def checkpoint_now(self) -> dict:
+    def _decide_full(self, full: "Optional[bool]") -> bool:
+        """Full rebase or delta link?  Forced rebases win; otherwise a
+        delta needs a published parent, an unbroken dirty window, a
+        chain shorter than ``rebase_every``, and no staged (import)
+        cold sources waiting to be persisted locally."""
+        if full is not None:
+            return bool(full)
+        if self.force_rebase or self.last is None:
+            return True
+        if self.rebase_every <= 1 or self.chain_len + 1 >= self.rebase_every:
+            return True
+        if self.store.ckpt_dirty_keys is None \
+                or self.store._ckpt_dirty_blobs is None \
+                or getattr(self.txm, "ckpt_dirty_committed", 0) is None:
+            return True
+        for t in self.store.tables.values():
+            if t._ckpt_dirty is None:
+                return True
+        cold = self.store.cold
+        if cold is not None and cold._extra_sources:
+            return True  # staged import sidecar: persist it locally NOW
+        return False
+
+    def _consume_windows_locked(self) -> Tuple[Any, dict, set, dict]:
+        """Consume every incremental-stamp window under the commit-lock
+        barrier (both capture modes reset them — the next window starts
+        at this stamp).  Returns (dirty keys | None, evicted dict, blob
+        hash set, committed-delta dict)."""
+        store, txm = self.store, self.txm
+        dirty = store.ckpt_dirty_keys
+        store.ckpt_dirty_keys = set()
+        evicted = store._ckpt_evicted
+        store._ckpt_evicted = {}
+        blob_hashes = store._ckpt_dirty_blobs
+        store._ckpt_dirty_blobs = set()
+        committed_dirty = getattr(txm, "ckpt_dirty_committed", None)
+        if hasattr(txm, "ckpt_dirty_committed"):
+            txm.ckpt_dirty_committed = set()
+        if blob_hashes is None or committed_dirty is None:
+            dirty = None  # any overflowed window ⇒ rebase
+            blob_hashes = set()
+            committed_dirty = set()
+        committed = {}
+        for dk in committed_dirty:
+            v = txm.committed_keys.get(dk)
+            if v is not None:
+                committed[dk] = int(v)
+        for t in store.tables.values():
+            t.take_ckpt_dirty()
+        return dirty, evicted, blob_hashes, committed
+
+    def checkpoint_now(self, full: "Optional[bool]" = None) -> dict:
         with self._lock:
             t0 = time.monotonic()
             with self.txm.checkpoint_barrier:
-                cap, frozen = self._capture_locked()
+                want_full = self._decide_full(full)
+                if want_full:
+                    cap, frozen = self._capture_locked()
+                else:
+                    cap, frozen = self._capture_delta_locked()
+                    if cap is None:
+                        want_full = True
+                        cap, frozen = self._capture_locked()
             barrier_s = time.monotonic() - t0
             try:
                 self._scan_chains(cap)
-                path, manifest = self._write_atomic(cap, frozen)
+                if want_full:
+                    path, manifest = self._write_atomic(cap, frozen)
+                else:
+                    path, manifest = self._write_atomic_delta(cap, frozen)
             except CheckpointError:
+                self.force_rebase = True  # the consumed dirty windows
+                # are gone; only a rebase re-covers everything
                 raise
             except BaseException as e:
                 # a failed checkpoint must leave the store EXACTLY as it
@@ -591,6 +938,7 @@ class Checkpointer:
                 # retry reuses the already-rotated generation), so hours
                 # of failing cycles never leak fds
                 self.log.drain_retired()
+                self.force_rebase = True
                 if self.metrics is not None:
                     self.metrics.checkpoint_total.inc(status="error")
                 raise CheckpointError(
@@ -599,20 +947,39 @@ class Checkpointer:
             with self.txm.checkpoint_barrier:
                 self.log.set_floor(cap["floor_seqs"], cap["chain_floor"])
             self._rotated_unpublished = False
-            reclaimed = self._retire_and_reclaim(cap)
+            if want_full:
+                self.chain_len = 0
+                self.force_rebase = False
+                cold = self.store.cold
+                if cold is not None:
+                    # re-anchor every cold/evict ref onto the fresh image
+                    cold.rebind(cap["id"], cap.get("resident_map") or {},
+                                cap.get("cold_rebinds") or {},
+                                cap.get("cold_lost") or set())
+                    for token in list(cold._extra_sources):
+                        cold.drop_source(token)  # staged import persisted
+                reclaimed = self._retire_and_reclaim(cap)
+            else:
+                self.chain_len += 1
+                reclaimed = 0
             self.reclaimed_total += reclaimed
             manifest["reclaimed_bytes"] = reclaimed
             self.last = manifest
             if self.metrics is not None:
                 self.metrics.checkpoint_total.inc(status="ok")
+                self.metrics.checkpoint_stamp.inc(
+                    kind=manifest.get("kind", "full"))
+                self.metrics.checkpoint_stamp_rows.inc(
+                    manifest["n_rows"], kind=manifest.get("kind", "full"))
                 self.metrics.wal_reclaimed.inc(reclaimed)
                 self.metrics.checkpoint_age.set(0.0)
             total_s = time.monotonic() - t0
             log.info(
-                "checkpoint %d published: %d keys, %d table rows, "
+                "checkpoint %d (%s) published: %d keys, %d table rows, "
                 "%.1f MiB image, %.1f MiB WAL reclaimed "
                 "(stamp barrier %.0f ms, total %.2f s)",
-                manifest["id"], manifest["n_keys"], manifest["n_rows"],
+                manifest["id"], manifest.get("kind", "full"),
+                manifest["n_keys"], manifest["n_rows"],
                 manifest["image_bytes"] / 2**20, reclaimed / 2**20,
                 barrier_s * 1e3, total_s,
             )
@@ -649,6 +1016,15 @@ class Checkpointer:
             except Exception:
                 log.exception("checkpoint extras provider %r failed "
                               "(omitted from the image)", name)
+        # incremental windows reset at EVERY stamp (a full covers them)
+        self._consume_windows_locked()
+        # cold-tier snapshot: the still-cold keys this image must carry
+        # forward into its sidecar appendix (coords read off-lock — cold
+        # rows are immutable while cold, and a racing fault-in leaves
+        # the bytes untouched until a post-capture write, which the next
+        # window covers)
+        if store.cold is not None:
+            cap["cold_manifest"] = store.cold.cold_manifest()
         frozen: Dict[str, dict] = {}
         for tname, t in store.tables.items():
             used = t.used_rows.copy()
@@ -665,6 +1041,83 @@ class Checkpointer:
         # attempt already did and never published: its generation is
         # still "everything since the last publish", and rotating again
         # would open n_shards × n_segments new files per failing cycle
+        if not self._rotated_unpublished:
+            logm.rotate_generation()
+            self._rotated_unpublished = True
+        cap["floor_seqs"] = logm.seqs.copy()
+        self._next_id += 1
+        return cap, frozen
+
+    def _capture_delta_locked(self):
+        """Delta-link capture: only the keys/rows dirtied since the
+        parent link.  Device gathers are COPY-DISPATCHED (materialized
+        outside the lock); the per-key bookkeeping deltas are host dict
+        copies bounded by the dirty set.  Returns (None, None) when the
+        windows turn out unusable — the caller falls back to a full
+        rebase (the windows were consumed either way; the rebase covers
+        everything)."""
+        store, txm, logm = self.store, self.txm, self.log
+        dirty, evicted, blob_hashes, committed = \
+            self._consume_windows_locked()
+        if dirty is None:
+            return None, None
+        anchor = store.cold.anchor if store.cold is not None else None
+        cold_delta = []
+        for dk, (tname, shard, srow, src) in evicted.items():
+            if src != anchor or isinstance(src, str):
+                return None, None  # unanchored eviction: rebase
+            cold_delta.append([dk[0], dk[1], tname, int(shard), int(srow)])
+        cap: Dict[str, Any] = {
+            "id": self._next_id,
+            "parent": int(self.last["id"]),
+            "n_shards": store.cfg.n_shards,
+            "max_dcs": store.cfg.max_dcs,
+            "stamp_vc": store.applied_vc.copy(),
+            "commit_counter": int(txm.commit_counter),
+            "op_ids": logm.op_ids.copy(),
+            "prev_floor": logm.floor_seqs.copy(),
+            "prev_chain_floor": logm.chain_floor.copy(),
+            "shard_resets": dict(logm.shard_resets),
+            "cold_delta": cold_delta,
+            "cold_src": anchor,
+            "committed_delta": [[k, b, v] for (k, b), v in
+                                committed.items()],
+            "blobs_delta": [
+                [int(h), bytes(store.blobs._by_handle[h])]
+                for h in blob_hashes if h in store.blobs._by_handle
+            ],
+            "blob_seen": [sorted(s) for s in logm._blob_seen],
+            "extras": {},
+        }
+        for name, provider in self.extras_providers.items():
+            try:
+                cap["extras"][name] = provider()
+            except Exception:
+                log.exception("checkpoint extras provider %r failed "
+                              "(omitted from the link)", name)
+        by_table: Dict[str, list] = {}
+        directory_delta = []
+        for dk in dirty:
+            ent = store.directory.get(dk)
+            if ent is None:
+                continue  # evicted after the write (rides cold_delta)
+            by_table.setdefault(ent[0], []).append((dk, ent[1], ent[2]))
+            directory_delta.append([dk[0], dk[1], ent[0], int(ent[1]),
+                                    int(ent[2])])
+        cap["directory_delta"] = directory_delta
+        frozen: Dict[str, dict] = {}
+        for tname, items in by_table.items():
+            t = store.tables[tname]
+            ss = np.asarray([x[1] for x in items], np.int64)
+            rr = np.asarray([x[2] for x in items], np.int64)
+            frozen[tname] = {
+                "rows": [[int(s), int(r)] for s, r in zip(ss, rr)],
+                "slot": t.gather_rows_dispatch(ss, rr),
+                "slots_ub": t.slots_ub[ss, rr].copy(),
+                "used_rows": t.used_rows.copy(),
+                "max_abs_delta": int(t.max_abs_delta),
+                "max_commit_vc": t.max_commit_vc.copy(),
+            }
         if not self._rotated_unpublished:
             logm.rotate_generation()
             self._rotated_unpublished = True
@@ -704,6 +1157,102 @@ class Checkpointer:
                 chains[shard, int(rec["o"])] += 1
         cap["chain_floor"] = chains
 
+    def _carry_cold(self, cap: dict, tables: Dict[str, dict]):
+        """Build the sidecar's cold appendix: every still-cold key's row
+        is read (bulk per column) from its source sidecar, per-row
+        CRC-verified, and re-addressed after the new image's resident
+        extent.  Unreadable rows become ``lost`` — surfaced loudly, and
+        tombstoned so their reads fail typed instead of serving bottom.
+        Returns (appendix arrays merged into ``tables``, cold_directory
+        entries, rebind map, lost set)."""
+        cold_man = cap.get("cold_manifest") or {}
+        cold_dir: list = []
+        rebinds: Dict[Any, tuple] = {}
+        lost: set = set()
+        if not cold_man:
+            return cold_dir, rebinds, lost
+        cold = self.store.cold
+        for tname, by_shard in cold_man.items():
+            # group by source (one bulk column load per (src, table))
+            srcs = {src for items in by_shard.values()
+                    for _dk, _sr, src in items}
+            cols: Dict[Any, dict] = {}
+            for src in srcs:
+                sc = cold._sidecar(src)
+                tman = sc.man["tables"][tname]
+                cols[src] = {
+                    "fields": {f: sc.read_column(tname, f)
+                               for f in sorted(tman["fields"])},
+                    "head_vc": sc.read_column(tname, "head_vc"),
+                    "slots_ub": sc.read_column(tname, "slots_ub"),
+                    "row_crc": sc.read_column(tname, "row_crc"),
+                }
+            tb = tables.get(tname)
+            if tb is None:
+                # every key of this table is cold: synthesize an empty
+                # resident block with the right shapes from the source
+                any_src = next(iter(cols.values()))
+                p = self.store.cfg.n_shards
+                tb = tables[tname] = {
+                    "used_rows": np.zeros(p, np.int64),
+                    "head": {f: np.zeros((p, 0) + x.shape[2:], x.dtype)
+                             for f, x in any_src["fields"].items()},
+                    "head_vc": np.zeros((p, 0, self.store.cfg.max_dcs),
+                                        np.int32),
+                    "slots_ub": np.zeros((p, 0), np.int32),
+                    "max_abs_delta": 0,
+                    "max_commit_vc": np.zeros(self.store.cfg.max_dcs,
+                                              np.int32),
+                }
+            u_cap = tb["head_vc"].shape[1]
+            c_max = max(len(items) for items in by_shard.values())
+            p = tb["head_vc"].shape[0]
+            ext = {
+                "head": {f: np.zeros((p, u_cap + c_max) + x.shape[2:],
+                                     x.dtype)
+                         for f, x in tb["head"].items()},
+                "head_vc": np.zeros((p, u_cap + c_max,
+                                     tb["head_vc"].shape[2]), np.int32),
+                "slots_ub": np.zeros((p, u_cap + c_max), np.int32),
+            }
+            for f, x in tb["head"].items():
+                ext["head"][f][:, :u_cap] = x
+            ext["head_vc"][:, :u_cap] = tb["head_vc"]
+            ext["slots_ub"][:, :u_cap] = tb["slots_ub"]
+            for shard, items in by_shard.items():
+                for i, (dk, srow, src) in enumerate(items):
+                    c = cols[src]
+                    parts = [c["fields"][f][shard, srow].tobytes()
+                             for f in sorted(c["fields"])]
+                    parts.append(np.ascontiguousarray(
+                        c["head_vc"][shard, srow], np.int32).tobytes())
+                    parts.append(np.ascontiguousarray(
+                        c["slots_ub"][shard, srow], np.int32).tobytes())
+                    want = int(c["row_crc"][shard, srow])
+                    if (zlib.crc32(b"".join(parts)) & 0xFFFFFFFF) != want:
+                        lost.add(dk)
+                        log.error(
+                            "cold carry-forward: row CRC mismatch for "
+                            "%r (%s[%d,%d] of source %r) — the key's "
+                            "state is LOST to bit rot", dk, tname, shard,
+                            srow, src)
+                        continue
+                    new_row = u_cap + i
+                    for f in ext["head"]:
+                        ext["head"][f][shard, new_row] = \
+                            c["fields"][f][shard, srow]
+                    ext["head_vc"][shard, new_row] = \
+                        c["head_vc"][shard, srow]
+                    ext["slots_ub"][shard, new_row] = \
+                        c["slots_ub"][shard, srow]
+                    cold_dir.append([dk[0], dk[1], tname, int(shard),
+                                     int(new_row)])
+                    rebinds[dk] = (tname, int(shard), int(new_row))
+            tb["head"] = ext["head"]
+            tb["head_vc"] = ext["head_vc"]
+            tb["slots_ub"] = ext["slots_ub"]
+        return cold_dir, rebinds, lost
+
     def _write_atomic(self, cap: dict, frozen: dict) -> Tuple[str, dict]:
         from antidote_tpu.store.handoff import opaque, pack
 
@@ -721,8 +1270,34 @@ class Checkpointer:
                 "max_abs_delta": fz["max_abs_delta"],
                 "max_commit_vc": fz["max_commit_vc"],
             }
+        # the sidecar extends each table past its resident extent with
+        # the carried-forward cold rows; the IMAGE keeps only the
+        # resident slices (recovery installs exactly those on device)
+        resident_caps = {tname: tb["head_vc"].shape[1]
+                         for tname, tb in tables.items()}
+        cold_dir, rebinds, lost = self._carry_cold(cap, tables)
+        sidecar_tables = {
+            tname: {"head": tb["head"], "head_vc": tb["head_vc"],
+                    "slots_ub": tb["slots_ub"]}
+            for tname, tb in tables.items()
+        } if (self.store.cold is not None or cold_dir) else None
+        if cold_dir:
+            # restore the image's resident-only slices
+            tables = {
+                tname: dict(
+                    tb,
+                    head={f: x[:, :resident_caps[tname]]
+                          for f, x in tb["head"].items()},
+                    head_vc=tb["head_vc"][:, :resident_caps[tname]],
+                    slots_ub=tb["slots_ub"][:, :resident_caps[tname]],
+                )
+                for tname, tb in tables.items()
+            }
+        cap["resident_map"] = dict(cap["directory"])
+        cap["cold_rebinds"] = rebinds
+        cap["cold_lost"] = lost
         image = {
-            "version": 1,
+            "version": 2,
             "id": cap["id"],
             "n_shards": cap["n_shards"],
             "max_dcs": cap["max_dcs"],
@@ -747,48 +1322,137 @@ class Checkpointer:
             "blobs": opaque([[int(h), bytes(d)]
                              for h, d in cap["blobs"].items()]),
             "blob_seen": opaque(cap["blob_seen"]),
+            "cold_directory": opaque(cold_dir),
             "tables": tables,
             "extras": cap["extras"],
         }
         data = pack(image)
-        crc = zlib.crc32(data) & 0xFFFFFFFF
-        os.makedirs(self.root, exist_ok=True)
-        tmp = os.path.join(self.root, f"tmp.{os.getpid()}.{cap['id']}")
-        final = os.path.join(self.root, f"ckpt_{cap['id']}")
         manifest = {
             "id": cap["id"],
+            "kind": "full",
             "created_at": time.time(),
             "image_bytes": len(data),
-            "image_crc32": crc,
-            "n_keys": len(cap["directory"]),
+            "image_crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            "n_keys": len(cap["directory"]) + len(cold_dir),
             "n_rows": int(sum(int(t["used_rows"].sum())
                               for t in tables.values())),
+            "cold_keys": len(cold_dir),
             "tables": sorted(tables),
             "commit_counter": cap["commit_counter"],
             "stamp_vc_max": [int(x) for x in cap["stamp_vc"].max(axis=0)],
             "floor_seqs": [int(x) for x in cap["floor_seqs"]],
         }
+        return self._publish_dir(cap["id"], data, manifest, sidecar_tables)
+
+    def _write_atomic_delta(self, cap: dict, frozen: dict) -> Tuple[str, dict]:
+        from antidote_tpu.store.handoff import opaque, pack
+
+        tables: Dict[str, dict] = {}
+        n_rows = 0
+        for tname, fz in frozen.items():
+            head_cp, head_vc_cp = fz["slot"]
+            m = len(fz["rows"])  # drop the gather's bucket padding
+            tables[tname] = {
+                "rows": fz["rows"],
+                "head": {f: np.asarray(x)[:m].copy()
+                         for f, x in head_cp.items()},
+                "head_vc": np.asarray(head_vc_cp)[:m].copy(),
+                "slots_ub": fz["slots_ub"],
+                "used_rows": fz["used_rows"],
+                "max_abs_delta": fz["max_abs_delta"],
+                "max_commit_vc": fz["max_commit_vc"],
+            }
+            n_rows += len(fz["rows"])
+        link = {
+            "version": 2,
+            "kind": "delta",
+            "id": cap["id"],
+            "parent": cap["parent"],
+            "n_shards": cap["n_shards"],
+            "max_dcs": cap["max_dcs"],
+            "stamp_vc": cap["stamp_vc"],
+            "commit_counter": cap["commit_counter"],
+            "floor_seqs": cap["floor_seqs"],
+            "chain_floor": cap["chain_floor"],
+            "op_ids": cap["op_ids"],
+            "shard_resets": {str(k): v
+                             for k, v in cap["shard_resets"].items()},
+            "directory_delta": opaque(cap["directory_delta"]),
+            "committed_delta": opaque(cap["committed_delta"]),
+            "blobs_delta": opaque(cap["blobs_delta"]),
+            "blob_seen": opaque(cap["blob_seen"]),
+            "cold_delta": opaque(cap["cold_delta"]),
+            "cold_src": cap["cold_src"],
+            "tables": tables,
+            "extras": cap["extras"],
+        }
+        data = pack(link)
+        manifest = {
+            "id": cap["id"],
+            "kind": "delta",
+            "parent": int(cap["parent"]),
+            "created_at": time.time(),
+            "image_bytes": len(data),
+            "image_crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            "n_keys": len(cap["directory_delta"]),
+            "n_rows": n_rows,
+            "tables": sorted(tables),
+            "commit_counter": cap["commit_counter"],
+            "stamp_vc_max": [int(x) for x in cap["stamp_vc"].max(axis=0)],
+            "floor_seqs": [int(x) for x in cap["floor_seqs"]],
+        }
+        return self._publish_dir(cap["id"], data, manifest, None)
+
+    def _publish_dir(self, cap_id: int, data: bytes, manifest: dict,
+                     sidecar_tables) -> Tuple[str, dict]:
+        """Shared atomic publish: stream image + (optional) cold sidecar
+        + manifest into a temp dir, fsync through the group coordinator,
+        one rename.  A failure at ANY point leaves the published set
+        untouched."""
+        from antidote_tpu.store.coldtier import COLD_BIN, write_sidecar
+
+        os.makedirs(self.root, exist_ok=True)
+        tmp = os.path.join(self.root, f"tmp.{os.getpid()}.{cap_id}")
+        final = os.path.join(self.root, f"ckpt_{cap_id}")
         try:
             shutil.rmtree(tmp, ignore_errors=True)  # reclaim-ok: stale
             # temp dir from a crashed writer — never a published image
             os.makedirs(tmp)
             img_path = os.path.join(tmp, _IMAGE)
             with open(img_path, "wb") as f:
-                _faulted_write(f, data, f"ckpt_{cap['id']}")
+                _faulted_write(f, data, f"ckpt_{cap_id}")
                 f.flush()
                 # image durability rides the group-fsync coordinator —
                 # one fsync stream process-wide, coalesced with any
                 # commit barriers in flight
                 self.log._fsync.submit(
-                    [_ImageFsync(f.fileno(), f"ckpt_{cap['id']}")]
+                    [_ImageFsync(f.fileno(), f"ckpt_{cap_id}")]
                 ).wait()
+            if sidecar_tables is not None:
+                d = faults.hit("ckpt.write", key=f"ckpt_{cap_id}")
+                if d is not None:
+                    if d.action == "delay" and d.arg:
+                        time.sleep(float(d.arg))
+                    elif d.action in ("error", "io_error", "enospc"):
+                        raise OSError(
+                            errno.ENOSPC if d.action == "enospc"
+                            else errno.EIO,
+                            f"injected fault: ckpt.write cold ckpt_{cap_id}")
+                with open(os.path.join(tmp, COLD_BIN), "wb") as f:
+                    cman = write_sidecar(f, sidecar_tables)
+                    f.flush()
+                    self.log._fsync.submit(
+                        [_ImageFsync(f.fileno(), f"ckpt_{cap_id}")]
+                    ).wait()
+                cman["n_shards"] = self.store.cfg.n_shards
+                manifest["cold"] = cman
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
                 f.flush()
                 os.fsync(f.fileno())  # fsync-ok: manifest must be durable
                 # before the rename publishes the image
             _fsync_dir(tmp)
-            d = faults.hit("ckpt.rename", key=f"ckpt_{cap['id']}")
+            d = faults.hit("ckpt.rename", key=f"ckpt_{cap_id}")
             if d is not None:
                 if d.action == "delay" and d.arg:
                     time.sleep(float(d.arg))
@@ -804,29 +1468,43 @@ class Checkpointer:
         return final, manifest
 
     def _retire_and_reclaim(self, cap: dict) -> int:
-        """Post-publish housekeeping: drop images beyond the retention
-        window, then reclaim WAL files wholly below the OLDEST RETAINED
-        image's floor — not the newest.  The retention window is the
-        recovery safety margin (a corrupt newest image falls back to an
-        older one), and that fallback needs the older image's tail still
-        on disk.  Both steps are best-effort — a failure here never
-        unpublishes the image."""
+        """Post-publish housekeeping (runs on FULL publishes): drop full
+        images beyond the retention window and every delta link the new
+        rebase covers (links below the newest full), then reclaim WAL
+        files wholly below the OLDEST RETAINED FULL image's floor —
+        never a delta's.  Delta floors advance replay skipping, but the
+        WAL above the last full stays on disk so a corrupt mid-chain
+        link always falls back to full-image + longer tail.  Best-effort
+        — a failure here never unpublishes the image."""
         reclaim_floors = np.asarray(cap["floor_seqs"], np.int64)
         try:
-            published = list_checkpoints(self.root)
-            for _id, path in published[:-self.retain]:
-                shutil.rmtree(path, ignore_errors=True)  # reclaim-ok:
-                # beyond the retention window; newer images cover it
+            published = []
+            for _id, p in list_checkpoints(self.root):
+                m = load_manifest(p)
+                if m is not None:
+                    published.append((_id, p, m))
+            fulls = [(i, p, m) for i, p, m in published
+                     if manifest_kind(m) == "full"]
+            retained = fulls[-self.retain:]
+            retained_ids = {i for i, _p, _m in retained}
+            newest_full = retained[-1][0] if retained else -1
+            for _id, path, m in published:
+                if manifest_kind(m) == "full":
+                    if _id not in retained_ids:
+                        shutil.rmtree(path, ignore_errors=True)
+                        # reclaim-ok: full image beyond the retention
+                        # window; newer retained fulls cover it
+                elif _id < newest_full:
+                    shutil.rmtree(path, ignore_errors=True)  # reclaim-ok:
+                    # delta link below the newest rebase — the rebase
+                    # carries everything the link did
             for name in os.listdir(self.root):
                 if name.startswith("tmp."):
                     shutil.rmtree(os.path.join(self.root, name),
                                   ignore_errors=True)  # reclaim-ok:
                     # orphaned temp dir from a crashed/failed writer
-            floors = [
-                m["floor_seqs"] for _id, p in published[-self.retain:]
-                if (m := load_manifest(p)) is not None
-                and m.get("floor_seqs") is not None
-            ]
+            floors = [m["floor_seqs"] for _i, _p, m in retained
+                      if m.get("floor_seqs") is not None]
             if floors:
                 reclaim_floors = np.minimum.reduce(
                     [np.asarray(f, np.int64) for f in floors])
@@ -838,3 +1516,71 @@ class Checkpointer:
             log.warning("WAL reclaim below the checkpoint floor failed "
                         "(will retry next checkpoint)", exc_info=True)
             return 0
+
+    # -- background scrub (ISSUE 13 satellite) --------------------------
+    def _scrub_file(self, path: str, want_bytes: int, want_crc: int) -> bool:
+        """Rate-limited whole-file CRC verification (off the commit
+        lock; reads throttled to ``scrub_bps``)."""
+        crc = 0
+        n = 0
+        t0 = time.monotonic()
+        try:
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(_CHUNK)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+                    n += len(chunk)
+                    budget = n / max(self.scrub_bps, 1)
+                    spent = time.monotonic() - t0
+                    if budget > spent:
+                        time.sleep(min(budget - spent, 0.25))
+        except OSError:
+            return False
+        return n == int(want_bytes) and (crc & 0xFFFFFFFF) == int(want_crc)
+
+    def scrub(self) -> Dict[str, int]:
+        """One background bit-rot pass over every retained image/link:
+        re-read and CRC-verify ``image.bin`` (and the cold sidecar when
+        present).  A corrupt DELTA link is retired on the spot (the
+        chain re-anchors on the prefix) and a rebase is forced; a
+        corrupt FULL image forces a rebase but is kept published — its
+        per-row CRCs still guard individual cold fault-ins, and the
+        rebase decides per row what survives.  Counts land in
+        ``antidote_checkpoint_scrub_total{result}``."""
+        out = {"ok": 0, "corrupt": 0}
+        for _id, path in list_checkpoints(self.root):
+            m = load_manifest(path)
+            if m is None:
+                continue
+            ok = self._scrub_file(os.path.join(path, _IMAGE),
+                                  m.get("image_bytes", -1),
+                                  m.get("image_crc32", -1))
+            cold = m.get("cold")
+            if ok and cold is not None:
+                from antidote_tpu.store.coldtier import COLD_BIN
+
+                ok = self._scrub_file(os.path.join(path, COLD_BIN),
+                                      cold.get("bytes", -1),
+                                      cold.get("crc32", -1))
+            result = "ok" if ok else "corrupt"
+            out[result] += 1
+            self.scrub_counts[result] = self.scrub_counts.get(result, 0) + 1
+            if self.metrics is not None:
+                self.metrics.checkpoint_scrub.inc(result=result)
+            if ok:
+                continue
+            if manifest_kind(m) == "delta":
+                log.error("scrub: chain link ckpt_%d is corrupt on disk; "
+                          "retiring it and forcing a rebase", _id)
+                shutil.rmtree(path, ignore_errors=True)  # reclaim-ok:
+                # scrub-condemned delta link; the forced rebase below
+                # re-covers its window from live state
+            else:
+                log.error("scrub: full image ckpt_%d is corrupt on disk; "
+                          "forcing a rebase (kept published — per-row "
+                          "CRCs still guard cold fault-ins)", _id)
+            self.force_rebase = True
+            self.request()
+        return out

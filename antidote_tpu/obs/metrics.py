@@ -528,6 +528,46 @@ class NodeMetrics:
             "publishes and truncates nothing",
             ("status",),
         )
+        # incremental checkpoint chains + scrub + cold tier (ISSUE 13)
+        self.checkpoint_stamp = r.counter(
+            "antidote_checkpoint_stamp_total",
+            "Published checkpoint stamps by kind (full = rebase image "
+            "with cold sidecar; delta = parent-linked incremental link "
+            "whose cost scales with the dirty set)",
+            ("kind",),
+        )
+        self.checkpoint_stamp_rows = r.counter(
+            "antidote_checkpoint_stamp_rows_total",
+            "Table rows written per checkpoint stamp by kind — delta "
+            "rows track the write working set, full rows the resident "
+            "extent (the incremental-cost observable)",
+            ("kind",),
+        )
+        self.checkpoint_scrub = r.counter(
+            "antidote_checkpoint_scrub_total",
+            "Background bit-rot scrub verifications of retained "
+            "images/links (ok | corrupt — a corrupt delta link is "
+            "retired and a rebase forced)",
+            ("result",),
+        )
+        self.coldtier_events = r.counter(
+            "antidote_coldtier_events_total",
+            "Cold-tier transitions (evict = device row dropped to the "
+            "sidecar; fault = row faulted back in; refused = typed "
+            "ColdMiss past the rate cap or an I/O fault; crc_fail = "
+            "fault-in caught on-disk corruption; lost = key tombstoned "
+            "after bit rot on every retained image)",
+            ("event",),
+        )
+        self.coldtier_resident_rows = r.gauge(
+            "antidote_coldtier_resident_rows",
+            "Device rows currently holding key state (bounded by "
+            "--resident-rows when the cold tier is armed)",
+        )
+        self.coldtier_cold_keys = r.gauge(
+            "antidote_coldtier_cold_keys",
+            "Keys whose state lives only in the checkpoint sidecar",
+        )
         # follower read replicas & session tier (ISSUE 9): owner-side
         # lag per follower, session redirects (park-then-redirect +
         # not-owner write refusals), bootstrap/repair cycles by mode,
@@ -564,8 +604,24 @@ class NodeMetrics:
             "antidote_divergence_checks_total",
             "Follower-vs-owner per-shard digest comparisons (ok | "
             "skipped = applied clocks unequal, nothing comparable | "
-            "mismatch = divergence detected, follower re-bootstraps)",
+            "unsubscribed = the lag is on a peer lane this follower was "
+            "never given a descriptor for (--follower-peers) | "
+            "mismatch = divergence detected and healed)",
             ("result",),
+        )
+        # Merkle-split divergence repair (ISSUE 13)
+        self.merkle_probe_hashes = r.counter(
+            "antidote_merkle_probe_hashes_total",
+            "Hash comparisons spent walking the divergence Merkle tree "
+            "(O(fanout·log n) per localized mismatch — the flat digest "
+            "compared O(1) hashes but healed O(shard))",
+        )
+        self.divergence_heals = r.counter(
+            "antidote_divergence_heals_total",
+            "Divergence repairs by mode (range = Merkle-localized "
+            "leaf fetch, quarantine without re-install; image = full "
+            "re-bootstrap fallback)",
+            ("mode",),
         )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)

@@ -81,6 +81,25 @@ class ReplicaLagging(Exception):
         self.redirect = list(redirect) if redirect else None
 
 
+class ColdMiss(Exception):
+    """A read/write touched a cold-tier key whose device state could not
+    be faulted back in RIGHT NOW — the fault-rate cap is exceeded, the
+    fault-in hit an (injected or real) I/O error, or the backing
+    checkpoint sidecar failed its per-row CRC.  The request was NOT
+    served with a wrong value; the client retries after the hint (the
+    fault-in usually succeeds on the retry once pressure drains or the
+    scrub-forced rebase publishes).  ``permanent=True`` marks the one
+    unrecoverable case — the sidecar row is verifiably lost on every
+    retained image — which an operator heals by re-bootstrapping from a
+    peer/follower, never by a silent bottom read."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50,
+                 permanent: bool = False):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+        self.permanent = bool(permanent)
+
+
 class ReplicaDown(ConnectionError):
     """Every endpoint of a session (followers and owner alike) refused
     or dropped the request — the typed terminal error of the session
@@ -185,6 +204,6 @@ class AdmissionGate:
 
 
 __all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
-           "NotOwnerError", "ReplicaLagging", "ReplicaDown",
+           "NotOwnerError", "ReplicaLagging", "ReplicaDown", "ColdMiss",
            "AdmissionGate", "deadline_from_ms", "check_deadline",
            "retry_hint_ms"]

@@ -569,12 +569,14 @@ def _error_resp(e) -> Tuple[str, Dict[str, Any]]:
     sheds, follower session redirects (lagging/not_owner, carrying the
     retry hint + owner redirect in the errmsg), and the reference's
     catch-all shape for everything else."""
-    from antidote_tpu.overload import (BusyError, DeadlineExceeded,
-                                       NotOwnerError, ReadOnlyError,
-                                       ReplicaLagging)
+    from antidote_tpu.overload import (BusyError, ColdMiss,
+                                       DeadlineExceeded, NotOwnerError,
+                                       ReadOnlyError, ReplicaLagging)
 
     if isinstance(e, BusyError):
         text = error_text("busy", str(e), e.retry_after_ms)
+    elif isinstance(e, ColdMiss):
+        text = error_text("cold_miss", str(e), e.retry_after_ms)
     elif isinstance(e, DeadlineExceeded):
         text = error_text("deadline", str(e))
     elif isinstance(e, ReadOnlyError):

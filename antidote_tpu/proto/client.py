@@ -76,6 +76,20 @@ class RemoteLagging(RemoteError):
         self.redirect = redirect
 
 
+class RemoteColdMiss(RemoteError):
+    """A cold-tier key's fault-in was refused (rate cap, I/O fault, or
+    sidecar CRC failure): the read/write was NOT served — retry after
+    ``retry_after_ms``.  ``permanent=True`` means the key's backing row
+    is verifiably lost on every retained image (operator repair:
+    re-bootstrap the store from a peer/follower)."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50,
+                 permanent: bool = False):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+        self.permanent = bool(permanent)
+
+
 class ClientTxn:
     def __init__(self, client: "AntidoteClient", txid: int):
         self._client = client
@@ -150,6 +164,11 @@ class AntidoteClient:
                 raise RemoteLagging(resp.get("detail", ""),
                                     int(resp.get("retry_after_ms", 50)),
                                     redirect=resp.get("redirect"))
+            if err == "cold_miss":
+                raise RemoteColdMiss(resp.get("detail", ""),
+                                     int(resp.get("retry_after_ms", 50)),
+                                     permanent=bool(
+                                         resp.get("permanent")))
             raise RemoteError(f"{err}: {resp.get('detail')}")
         return resp
 

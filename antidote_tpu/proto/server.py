@@ -29,6 +29,7 @@ from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.overload import (
     AdmissionGate,
     BusyError,
+    ColdMiss,
     DeadlineExceeded,
     NotOwnerError,
     ReadOnlyError,
@@ -454,6 +455,16 @@ class ProtocolServer:
                         "error": "lagging", "detail": str(e),
                         "retry_after_ms": int(e.retry_after_ms),
                         "redirect": e.redirect,
+                    }
+                except ColdMiss as e:
+                    # cold-tier fault-in refused (rate cap / I/O fault /
+                    # CRC failure): the key's device row stays cold this
+                    # round — the client retries after the hint; the
+                    # value was NEVER served wrong
+                    resp_code, resp = MessageCode.ERROR_RESP, {
+                        "error": "cold_miss", "detail": str(e),
+                        "retry_after_ms": int(e.retry_after_ms),
+                        "permanent": bool(e.permanent),
                     }
                 except NotOwnerError as e:
                     resp_code, resp = MessageCode.ERROR_RESP, {

@@ -1,0 +1,676 @@
+"""Cold tier — beyond-RAM survival behind the slot-tier ladder (ISSUE 13).
+
+The reference survives datasets bigger than RAM with dets spill-to-disk
+tables (SURVEY §2.3/§2.6).  This module is that idea rebuilt for the
+device-table store: rows untouched since the newest FULL checkpoint
+image are **evicted** — the device copy is forgotten (the row zeroed
+through the guarded :meth:`TypedTable.evict_rows` and pushed onto the
+per-shard free list for reuse), while the image keeps the state — and
+**faulted back in** on the next read or write through the locked path.
+Because the image already holds VC-stamped heads per table, eviction
+needs no extra write: it is "forget the device copy, keep the floor".
+
+The addressing side is the checkpoint **cold sidecar** (``cold.bin``
+next to ``image.bin``): the same per-table head columns laid out as raw
+fixed-stride binaries with a per-row CRC, so a fault-in is a handful of
+``pread`` calls — never a whole-image decode.  :func:`write_sidecar` /
+:class:`Sidecar` own the format; the checkpoint writer emits it on every
+full stamp (carrying still-cold rows forward as an appendix, so
+retention never strands cold data).
+
+Failure contract (the "no silent wrong reads" leg of ISSUE 13):
+
+  * a fault-in past the fault-rate cap, behind an injected/real I/O
+    error (site ``coldtier.fault``), or over a row that fails its CRC is
+    refused with a typed :class:`~antidote_tpu.overload.ColdMiss` carrying
+    a retry hint — the read parks client-side and retries, it is never
+    served bottom;
+  * a row verifiably lost on every retained image (bit rot caught by the
+    scrubber mid-rebase) is tombstoned: reads raise a *permanent*
+    ColdMiss naming the repair (re-bootstrap from a peer/follower);
+  * eviction only ever drops rows whose live ``head_vc`` is byte-equal
+    to the sidecar's stored stamp — a row written since the image is
+    simply not evictable until the next stamp covers it.
+
+RSS bounding: ``budget`` caps the store's RESIDENT device rows (the
+allocation high-water mark minus freed rows).  Past it, the coldest
+eligible keys (write-LRU) are evicted in bounded batches from the commit
+path; when nothing is eligible (no image yet, or everything dirty since
+the stamp) the tier asks the checkpointer for a stamp instead of ever
+refusing a write.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from antidote_tpu import faults
+from antidote_tpu.overload import ColdMiss, retry_hint_ms
+
+log = logging.getLogger(__name__)
+
+#: sidecar file name inside a published checkpoint directory
+COLD_BIN = "cold.bin"
+
+
+# ---------------------------------------------------------------------------
+# sidecar format: raw fixed-stride columns + per-row CRC
+# ---------------------------------------------------------------------------
+def _row_bytes(spec: dict) -> int:
+    return int(np.dtype(spec["dtype"]).itemsize
+               * int(np.prod(spec["shape"], dtype=np.int64)))
+
+
+def write_sidecar(fh, tables: Dict[str, dict]) -> dict:
+    """Stream the cold sidecar for one full image and return its
+    manifest block.  ``tables`` maps tiered table names to
+    ``{"head": {field: arr[P, R, ...]}, "head_vc": arr[P, R, D],
+    "slots_ub": arr[P, R]}`` host arrays (R = resident extent + cold
+    appendix).  Layout: each column contiguous C-order at a recorded
+    offset; ``row_crc`` is crc32 over the row's concatenated column
+    bytes (sorted field order, then head_vc, then slots_ub) — the
+    fault-in's integrity check."""
+    manifest: Dict[str, Any] = {"tables": {}}
+    off = 0
+    crc_total = 0
+
+    def emit(arr: np.ndarray) -> dict:
+        nonlocal off, crc_total
+        arr = np.ascontiguousarray(arr)
+        data = arr.tobytes()
+        fh.write(data)
+        crc_total = zlib.crc32(data, crc_total)
+        spec = {"off": off, "dtype": str(arr.dtype),
+                "shape": list(arr.shape[2:])}
+        off += len(data)
+        return spec
+
+    for tname in sorted(tables):
+        tb = tables[tname]
+        p, r = tb["head_vc"].shape[:2]
+        cols = []  # (per-row byte matrices for the CRC pass)
+        tman: Dict[str, Any] = {"rows": int(r), "fields": {}}
+        for f in sorted(tb["head"]):
+            arr = np.ascontiguousarray(tb["head"][f])
+            tman["fields"][f] = emit(arr)
+            cols.append(arr.reshape(p * r, -1).view(np.uint8))
+        hvc = np.ascontiguousarray(tb["head_vc"], np.int32)
+        tman["head_vc"] = emit(hvc)
+        cols.append(hvc.reshape(p * r, -1).view(np.uint8))
+        sub = np.ascontiguousarray(tb["slots_ub"], np.int32)
+        tman["slots_ub"] = emit(sub)
+        cols.append(sub.reshape(p * r, -1).view(np.uint8))
+        rowmat = np.concatenate(cols, axis=1)
+        crc = np.empty(p * r, np.uint32)
+        for i in range(p * r):
+            crc[i] = zlib.crc32(rowmat[i].tobytes()) & 0xFFFFFFFF
+        tman["row_crc"] = emit(crc.reshape(p, r))
+        manifest["tables"][tname] = tman
+    manifest["bytes"] = off
+    manifest["crc32"] = crc_total & 0xFFFFFFFF
+    return manifest
+
+
+class Sidecar:
+    """pread-style reader over one published cold sidecar."""
+
+    def __init__(self, path: str, manifest: dict):
+        self.path = path
+        self.man = manifest
+        self._fd: Optional[int] = None
+
+    def _fileno(self) -> int:
+        if self._fd is None:
+            self._fd = os.open(self.path, os.O_RDONLY)
+        return self._fd
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def _pread(self, off: int, n: int) -> bytes:
+        data = os.pread(self._fileno(), n, off)
+        if len(data) != n:
+            raise OSError(f"short sidecar read at {off} ({len(data)}/{n})")
+        return data
+
+    def _col_row(self, tman: dict, spec: dict, shard: int,
+                 row: int) -> np.ndarray:
+        rb = _row_bytes(spec)
+        off = spec["off"] + (shard * tman["rows"] + row) * rb
+        return np.frombuffer(self._pread(off, rb),
+                             np.dtype(spec["dtype"])).reshape(spec["shape"])
+
+    def read_row(self, tname: str, shard: int, row: int) -> dict:
+        """One row's (head fields, head_vc, slots_ub), CRC-verified.
+        Raises ValueError on a CRC mismatch (the caller types it)."""
+        tman = self.man["tables"][tname]
+        if not (0 <= row < tman["rows"]):
+            raise ValueError(f"sidecar row {row} out of range for {tname}")
+        parts: List[bytes] = []
+        head = {}
+        for f in sorted(tman["fields"]):
+            arr = self._col_row(tman, tman["fields"][f], shard, row)
+            head[f] = arr
+            parts.append(arr.tobytes())
+        hvc = self._col_row(tman, tman["head_vc"], shard, row)
+        parts.append(hvc.tobytes())
+        sub = self._col_row(tman, tman["slots_ub"], shard, row)
+        parts.append(sub.tobytes())
+        want = int(self._col_row(tman, tman["row_crc"], shard, row))
+        got = zlib.crc32(b"".join(parts)) & 0xFFFFFFFF
+        if got != want:
+            raise ValueError(
+                f"sidecar row CRC mismatch for {tname}[{shard},{row}] "
+                f"({got:#x} != {want:#x}): bit rot on disk")
+        return {"head": head, "head_vc": hvc, "slots_ub": int(sub)}
+
+    def read_head_vc(self, tname: str, shard: int, row: int) -> np.ndarray:
+        """Just the stored head_vc stamp (the evictability probe)."""
+        tman = self.man["tables"][tname]
+        return self._col_row(tman, tman["head_vc"], shard, row)
+
+    def read_column(self, tname: str, name: str) -> np.ndarray:
+        """One whole column ``[P, rows, ...]`` in a single bulk read —
+        the rebase carry-forward path (never per-row syscalls at scale).
+        ``name`` is a head field, ``"head_vc"`` or ``"slots_ub"``."""
+        tman = self.man["tables"][tname]
+        spec = (tman["fields"][name] if name in tman["fields"]
+                else tman[name])
+        rb = _row_bytes(spec)
+        p = int(self.man["n_shards"])
+        data = self._pread(spec["off"], rb * tman["rows"] * p)
+        return np.frombuffer(data, np.dtype(spec["dtype"])).reshape(
+            [p, tman["rows"]] + list(spec["shape"]))
+
+
+# ---------------------------------------------------------------------------
+# the tier
+# ---------------------------------------------------------------------------
+class ColdRef:
+    """Where a key's head state lives on disk: (tiered table, shard,
+    sidecar row) inside one retained full image (``src`` = ckpt id, or a
+    string token for a staged import — follower bootstrap)."""
+
+    __slots__ = ("tname", "shard", "srow", "src")
+
+    def __init__(self, tname: str, shard: int, srow: int, src):
+        self.tname = tname
+        self.shard = int(shard)
+        self.srow = int(srow)
+        self.src = src
+
+    def __repr__(self):
+        return f"ColdRef({self.tname}, {self.shard}, {self.srow}, {self.src})"
+
+
+class ColdTier:
+    """Per-store cold-tier manager (see module docstring)."""
+
+    #: rows evicted per commit-path cycle at most (bounds the lock hold)
+    EVICT_BATCH = 4096
+    #: LRU entries probed per cycle at most (skips are re-queued)
+    SCAN_CAP = 16384
+
+    def __init__(self, store, budget: int = 0,
+                 fault_rate_cap: float = 0.0, lock=None):
+        self.store = store
+        #: resident device-row budget; 0 = unbounded (fault-in only)
+        self.budget = int(budget)
+        #: admitted fault-ins per second past which reads are refused
+        #: with a typed ColdMiss (0 = unlimited)
+        self.fault_rate_cap = float(fault_rate_cap)
+        self.lock = lock if lock is not None else threading.RLock()
+        #: dk -> ColdRef for every key a retained full image covers (cold
+        #: keys authoritative; resident keys keep theirs as evict hints)
+        self.refs: Dict[Tuple[Any, str], ColdRef] = {}
+        #: keys currently COLD (no device row, no directory entry)
+        self.cold_set: set = set()
+        #: shard -> set of cold dks (digest / handoff sweeps)
+        self.by_shard: Dict[int, set] = {}
+        #: write-LRU over RESIDENT keys (move_to_end on write/birth)
+        self.lru: "OrderedDict[Tuple[Any, str], None]" = OrderedDict()
+        #: keys whose sidecar rows are verifiably lost (typed-permanent)
+        self.lost: set = set()
+        #: the newest full image id refs were rebound to (evict anchor)
+        self.anchor: Optional[int] = None
+        #: extra sidecar sources: token -> Sidecar (staged imports)
+        self._extra_sources: Dict[str, Sidecar] = {}
+        self._sidecars: Dict[Any, Sidecar] = {}
+        #: keys probed NOT-evictable against the current anchor (written
+        #: since its stamp): within one anchor that can never change, so
+        #: probe each at most once instead of re-preading every cycle
+        self._probed_dirty: set = set()
+        #: called when the budget cannot be met (checkpointer.request)
+        self.on_pressure = None
+        #: called when a fault-in caught on-disk corruption (scrub nudge)
+        self.on_corrupt = None
+        self.evictions = 0
+        self.faults = 0
+        self.refused = 0
+        self._fault_window_t0 = time.monotonic()
+        self._fault_window_n = 0
+        self._fault_streak = 0
+        #: resolved once (recovery's replay detaches store.log while it
+        #: applies the tail — fault-ins must keep working through that)
+        self._log_dir: Optional[str] = (store.log.dir
+                                        if store.log is not None else None)
+
+    # -- metrics helper -------------------------------------------------
+    def _count(self, event: str, n: int = 1) -> None:
+        m = getattr(self.store, "metrics", None)
+        if m is not None:
+            m.coldtier_events.inc(n, event=event)
+
+    def _gauges(self) -> None:
+        m = getattr(self.store, "metrics", None)
+        if m is not None:
+            m.coldtier_resident_rows.set(self.resident_rows())
+            m.coldtier_cold_keys.set(len(self.cold_set))
+
+    # -- sources --------------------------------------------------------
+    def _sidecar(self, src) -> Sidecar:
+        sc = self._sidecars.get(src)
+        if sc is not None:
+            return sc
+        if isinstance(src, str):
+            sc = self._extra_sources.get(src)
+            if sc is None:
+                raise ColdMiss(
+                    f"cold source {src!r} is gone (staged import already "
+                    "consumed); retry after the local rebase",
+                    retry_after_ms=250)
+        else:
+            from antidote_tpu.log import checkpoint as _ckpt
+
+            if self._log_dir is None:
+                assert self.store.log is not None, \
+                    "cold tier needs a durable log dir"
+                self._log_dir = self.store.log.dir
+            root = _ckpt.checkpoint_root(self._log_dir)
+            path = os.path.join(root, f"ckpt_{int(src)}")
+            man = _ckpt.load_manifest(path)
+            if man is None or "cold" not in man:
+                raise ColdMiss(
+                    f"checkpoint image ckpt_{src} (the cold anchor) is "
+                    "no longer published; retry after the next rebase",
+                    retry_after_ms=250)
+            cman = dict(man["cold"])
+            cman.setdefault("n_shards", self.store.cfg.n_shards)
+            sc = Sidecar(os.path.join(path, COLD_BIN), cman)
+        self._sidecars[src] = sc
+        return sc
+
+    def add_source(self, token: str, path: str, manifest: dict) -> None:
+        """Register a staged sidecar source (follower bootstrap: the
+        fetched owner sidecar, consumed by the next local rebase)."""
+        cman = dict(manifest)
+        cman.setdefault("n_shards", self.store.cfg.n_shards)
+        self._extra_sources[token] = Sidecar(path, cman)
+
+    def drop_source(self, token: str) -> None:
+        sc = self._extra_sources.pop(token, None)
+        if sc is not None:
+            sc.close()
+        self._sidecars.pop(token, None)
+
+    def _drop_sidecar_cache(self) -> None:
+        for sc in self._sidecars.values():
+            sc.close()
+        self._sidecars = {}
+
+    # -- bookkeeping hooks ---------------------------------------------
+    def note_birth(self, dk) -> None:
+        self.lru[dk] = None
+        self.lru.move_to_end(dk)
+
+    def note_writes(self, dks) -> None:
+        lru = self.lru
+        for dk in dks:
+            lru[dk] = None
+            lru.move_to_end(dk)
+
+    def drop_shard(self, shard: int) -> None:
+        """Forget a relinquished shard's cold refs (the rows now live at
+        the handoff receiver)."""
+        with self.lock:
+            for dk in self.by_shard.pop(int(shard), set()):
+                self.cold_set.discard(dk)
+                self.refs.pop(dk, None)
+            for dk in [d for d, r in self.refs.items()
+                       if r.shard == int(shard)]:
+                self.refs.pop(dk, None)
+                self.lru.pop(dk, None)
+
+    def resident_rows(self) -> int:
+        return sum(t.resident_rows() for t in self.store.tables.values())
+
+    def is_cold(self, dk) -> bool:
+        # lost keys stay "cold" forever: their fault-in raises the
+        # typed-permanent ColdMiss — a directory miss must NEVER decay
+        # into a silent bottom read for a key that once held data
+        return dk in self.cold_set or dk in self.lost
+
+    def shard_cold_keys(self, shard: int):
+        return self.by_shard.get(int(shard), frozenset())
+
+    # -- rebind after a full publish ------------------------------------
+    def rebind(self, ckpt_id: int, resident_map: Dict, cold_rebinds: Dict,
+               lost: Optional[set] = None) -> None:
+        """Re-anchor every ref onto the freshly-published full image:
+        ``resident_map`` maps resident-at-stamp dks to their image
+        coordinates (bounded by the resident budget), ``cold_rebinds``
+        maps still-cold dks to their appendix coordinates.  Keys the new
+        image could not carry (unreadable source rows) arrive in
+        ``lost`` and are tombstoned — their reads go typed-permanent,
+        never bottom."""
+        with self.lock:
+            for dk, (tname, shard, srow) in resident_map.items():
+                self.refs[dk] = ColdRef(tname, shard, srow, ckpt_id)
+            for dk, (tname, shard, srow) in cold_rebinds.items():
+                self.refs[dk] = ColdRef(tname, shard, srow, ckpt_id)
+            if lost:
+                for dk in lost:
+                    self.refs.pop(dk, None)
+                    self.cold_set.discard(dk)
+                    self.lost.add(dk)
+                    for s in self.by_shard.values():
+                        s.discard(dk)
+                self._count("lost", len(lost))
+                log.error(
+                    "cold tier: %d key(s) LOST to sidecar bit rot during "
+                    "the rebase; their reads now fail typed-permanent "
+                    "(repair: re-bootstrap this store from a peer)",
+                    len(lost))
+            self.anchor = int(ckpt_id)
+            self._probed_dirty.clear()  # fresh anchor: re-probe
+            self._drop_sidecar_cache()
+            self._gauges()
+
+    def seed_hints(self, src) -> None:
+        """After a full-image install (recovery): every resident key's
+        directory entry IS its sidecar coordinate — register them as
+        evict hints so the post-recovery budget pass (and later
+        commit-path eviction) has candidates.  Rows later overlaid by
+        chain links or the WAL tail fail the head_vc equality probe and
+        simply stay resident."""
+        with self.lock:
+            for dk, ent in self.store.directory.items():
+                self.refs[dk] = ColdRef(ent[0], ent[1], ent[2], src)
+                self.lru[dk] = None
+            if not isinstance(src, str):
+                self.anchor = int(src)
+
+    def seed(self, entries, src) -> None:
+        """Register cold keys from a recovered/installed image's
+        ``cold_directory`` (``entries``: [key, bucket, tname, shard,
+        srow] rows; ``src``: the image id or staged-source token)."""
+        from antidote_tpu.store.kv import freeze_key
+
+        with self.lock:
+            for key, bucket, tname, shard, srow in entries:
+                dk = (freeze_key(key), bucket)
+                self.refs[dk] = ColdRef(tname, int(shard), int(srow), src)
+                self.cold_set.add(dk)
+                self.by_shard.setdefault(int(shard), set()).add(dk)
+            if not isinstance(src, str):
+                self.anchor = int(src)
+            self._gauges()
+
+    def cold_manifest(self) -> Dict[str, Dict[int, list]]:
+        """The rebase carry-forward worklist, captured under the lock:
+        {tiered name: {shard: [(dk, srow, src), ...]}} for every
+        currently-cold key."""
+        out: Dict[str, Dict[int, list]] = {}
+        for dk in self.cold_set:
+            ref = self.refs[dk]
+            out.setdefault(ref.tname, {}).setdefault(ref.shard, []).append(
+                (dk, ref.srow, ref.src))
+        return out
+
+    # -- fault-in -------------------------------------------------------
+    def _admit_fault(self) -> None:
+        if self.fault_rate_cap <= 0:
+            self._fault_streak = 0
+            return
+        now = time.monotonic()
+        if now - self._fault_window_t0 >= 1.0:
+            self._fault_window_t0 = now
+            self._fault_window_n = 0
+        if self._fault_window_n >= self.fault_rate_cap:
+            self._fault_streak += 1
+            self.refused += 1
+            self._count("refused")
+            raise ColdMiss(
+                f"cold-tier fault rate cap ({self.fault_rate_cap}/s) "
+                "exceeded; the key stays cold this round",
+                retry_after_ms=retry_hint_ms(self._fault_streak))
+        self._fault_window_n += 1
+        self._fault_streak = 0
+
+    def fault_in(self, dk, admit: bool = True):
+        """Fault one cold key's device row back in; returns the fresh
+        directory entry.  Caller must hold the store's commit lock (the
+        tier's ``lock`` is re-entrant and re-taken here)."""
+        with self.lock:
+            ent = self.store.directory.get(dk)
+            if ent is not None:
+                return ent  # raced: someone else faulted it in
+            if dk in self.lost:
+                raise ColdMiss(
+                    f"cold key {dk!r}: its sidecar row was lost to bit "
+                    "rot on every retained image — restore this store "
+                    "from a peer/follower", retry_after_ms=60000,
+                    permanent=True)
+            ref = self.refs.get(dk)
+            if ref is None or dk not in self.cold_set:
+                raise KeyError(f"{dk!r} is not a cold key")
+            if admit:
+                self._admit_fault()
+            d = faults.hit("coldtier.fault", key=ref.tname)
+            if d is not None:
+                if d.action == "delay" and d.arg:
+                    time.sleep(float(d.arg))
+                elif d.action in ("error", "io_error", "enospc"):
+                    self.refused += 1
+                    self._count("refused")
+                    raise ColdMiss(
+                        f"injected fault: coldtier.fault {dk!r}",
+                        retry_after_ms=50)
+            try:
+                rowdata = self._sidecar(ref.src).read_row(
+                    ref.tname, ref.shard, ref.srow)
+            except ValueError as e:
+                # on-disk corruption caught by the per-row CRC: typed
+                # refusal + nudge the scrubber (a forced rebase re-reads
+                # every row and tombstones the truly lost ones)
+                self._count("crc_fail")
+                cb = self.on_corrupt
+                if cb is not None:
+                    cb()
+                raise ColdMiss(
+                    f"cold fault-in for {dk!r} failed verification "
+                    f"({e}); a rebase was requested — retry after it",
+                    retry_after_ms=500) from e
+            except OSError as e:
+                self.refused += 1
+                self._count("refused")
+                raise ColdMiss(
+                    f"cold fault-in for {dk!r} hit an I/O error ({e})",
+                    retry_after_ms=100) from e
+            t = self.store.table(ref.tname)
+            row = t.alloc_row(ref.shard)
+            t.install_rows(
+                np.asarray([ref.shard]), np.asarray([row]),
+                {f: x[None] for f, x in rowdata["head"].items()},
+                rowdata["head_vc"][None],
+            )
+            t.slots_ub[ref.shard, row] = rowdata["slots_ub"]
+            ent = (ref.tname, ref.shard, row)
+            self.store.directory[dk] = ent
+            self.cold_set.discard(dk)
+            s = self.by_shard.get(ref.shard)
+            if s is not None:
+                s.discard(dk)
+            self.note_birth(dk)
+            self.store._ckpt_evicted.pop(dk, None)  # resident again
+            # the (possibly reused) row must not serve from any frozen
+            # epoch buffer: same discipline as a tier promotion
+            self.store.mark_epoch_fallback(dk)
+            self.faults += 1
+            self._count("fault")
+            self._gauges()
+            return ent
+
+    def fault_in_shard(self, shard: int) -> int:
+        """Fault in every cold key of one shard (handoff export /
+        relinquish sweeps run on whole-shard state).  Bypasses the rate
+        cap — these are operator-paced paths."""
+        n = 0
+        for dk in list(self.shard_cold_keys(shard)):
+            self.fault_in(dk, admit=False)
+            n += 1
+        return n
+
+    # -- eviction -------------------------------------------------------
+    def maybe_evict(self) -> int:
+        """Commit-path budget enforcement: when resident rows exceed the
+        budget, evict the coldest ELIGIBLE keys (live head_vc byte-equal
+        to the anchor sidecar's stamp) in one bounded batch.  Returns
+        rows evicted.  No-op (cheap) under budget."""
+        if self.budget <= 0:
+            return 0
+        over = self.resident_rows() - self.budget
+        if over <= 0:
+            return 0
+        return self.evict_now(max_rows=min(over, self.EVICT_BATCH))
+
+    def enforce_budget(self) -> int:
+        """Evict in bounded batches until the budget holds or nothing
+        more is eligible (recovery's post-install pass: a beyond-RAM
+        restart must not serve with the whole image resident)."""
+        total = 0
+        while self.budget > 0:
+            over = self.resident_rows() - self.budget
+            if over <= 0:
+                break
+            n = self.evict_now(max_rows=min(over, self.EVICT_BATCH))
+            total += n
+            if n == 0:
+                break  # everything left is dirty/uncovered
+        return total
+
+    def evict_now(self, max_rows: int = EVICT_BATCH) -> int:
+        """Evict up to ``max_rows`` of the coldest eligible keys."""
+        with self.lock:
+            if self.anchor is None:
+                cb = self.on_pressure
+                if cb is not None:
+                    cb()
+                return 0
+            try:
+                sc = self._sidecar(self.anchor)
+            except ColdMiss:
+                return 0
+            picked: Dict[str, list] = {}  # tname -> [(dk, shard, row)]
+            n_picked = 0
+            scanned = 0
+            hvc_cache: Dict[str, np.ndarray] = {}
+            for dk in list(self.lru):
+                if n_picked >= max_rows or scanned >= self.SCAN_CAP:
+                    break
+                scanned += 1
+                if dk in self._probed_dirty:
+                    # already proved unevictable against THIS anchor (a
+                    # row only gets dirtier within one anchor): no
+                    # re-pread until the next stamp re-anchors
+                    self.lru.move_to_end(dk)
+                    continue
+                ref = self.refs.get(dk)
+                ent = self.store.directory.get(dk)
+                if ent is None:
+                    self.lru.pop(dk, None)  # already gone/cold
+                    continue
+                if (ref is None or ref.src != self.anchor
+                        or ref.tname != ent[0] or ref.shard != ent[1]):
+                    # not covered by the anchor image (born/promoted
+                    # since the stamp): re-queue behind the hot end so
+                    # the scan makes progress
+                    self._probed_dirty.add(dk)
+                    self.lru.move_to_end(dk)
+                    continue
+                tname, shard, row = ent
+                hvc = hvc_cache.get(tname)
+                if hvc is None:
+                    t = self.store.table(tname)
+                    hvc = np.asarray(t.head_vc)
+                    hvc_cache[tname] = hvc
+                try:
+                    stored = sc.read_head_vc(tname, ref.shard, ref.srow)
+                except (OSError, ValueError, KeyError):
+                    self._probed_dirty.add(dk)
+                    self.lru.move_to_end(dk)
+                    continue
+                if not np.array_equal(hvc[shard, row], stored):
+                    # written since the stamp: not evictable yet
+                    self._probed_dirty.add(dk)
+                    self.lru.move_to_end(dk)
+                    continue
+                picked.setdefault(tname, []).append((dk, shard, row))
+                n_picked += 1
+            evicted = 0
+            for tname, items in picked.items():
+                t = self.store.table(tname)
+                t.evict_rows(np.asarray([x[1] for x in items]),
+                             np.asarray([x[2] for x in items]))
+                for dk, shard, _row in items:
+                    ref = self.refs[dk]
+                    self.store.directory.pop(dk, None)
+                    self.lru.pop(dk, None)
+                    self.cold_set.add(dk)
+                    self.by_shard.setdefault(shard, set()).add(dk)
+                    self.store.mark_epoch_fallback(dk)
+                    self.store.drop_cached_value(dk)
+                    # record the transition for the incremental chain: a
+                    # composed recovery must re-register the key cold
+                    # instead of resurrecting the (now reusable) row
+                    self.store._ckpt_evicted[dk] = (
+                        ref.tname, ref.shard, ref.srow, ref.src)
+                evicted += len(items)
+            if evicted:
+                self.evictions += evicted
+                self._count("evict", evicted)
+                self._gauges()
+            if self.resident_rows() > self.budget and evicted < max_rows:
+                # could not reach the budget (everything hot/dirty):
+                # ask for a stamp so the next cycle has coverage
+                cb = self.on_pressure
+                if cb is not None:
+                    cb()
+            return evicted
+
+    # -- observability --------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "budget": self.budget,
+            "resident_rows": self.resident_rows(),
+            "cold_keys": len(self.cold_set),
+            "lost_keys": len(self.lost),
+            "anchor_image": self.anchor,
+            "evictions": self.evictions,
+            "faults": self.faults,
+            "refused": self.refused,
+            "fault_rate_cap": self.fault_rate_cap,
+        }
+
+
+__all__ = ["ColdTier", "ColdRef", "Sidecar", "write_sidecar", "COLD_BIN"]

@@ -61,6 +61,11 @@ def export_shard(store: KVStore, shard: int,
     Returns a dict of host arrays + metadata; ``pack``/``unpack`` turn it
     into wire bytes for a cross-node move.
     """
+    if store.cold is not None:
+        # whole-shard export works on device state: every cold key of
+        # the shard must fault back in first (operator-paced path — the
+        # rate cap does not apply)
+        store.cold.fault_in_shard(int(shard))
     with_log = include_log and store.log is not None
     # a checkpoint-truncated source (ISSUE 8): the ride-along log is only
     # the tail above the compaction floor — the importer's WAL cannot
@@ -135,6 +140,8 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
             raise ValueError(
                 f"import_shard: {dk!r} already bound on this replica"
             )
+    if store.merkle is not None:
+        store.merkle.mark_all(dst)
     # exclusive ownership: a shard has one home per ring epoch.  Importing
     # into a shard that already holds rows would merge two partial copies
     # of the same (origin, shard) replication chains — the duplicate
@@ -232,6 +239,12 @@ def import_shard(store: KVStore, pkg: Dict[str, Any],
 
 def drop_shard(store: KVStore, shard: int) -> None:
     """Clear a shard after a successful handoff (source side)."""
+    if store.cold is not None:
+        # cold refs travel with the shard (the receiver faulted them in
+        # via the export's fault_in_shard); local refs must not linger
+        store.cold.drop_shard(shard)
+    if store.merkle is not None:
+        store.merkle.mark_all(shard)
     for t in store.tables.values():
         used = int(t.used_rows[shard])
         if used:
@@ -249,6 +262,7 @@ def drop_shard(store: KVStore, shard: int) -> None:
             t.n_ops[shard] = 0
             t.slots_ub[shard] = 0
         t.used_rows[shard] = 0
+        t.free_rows.pop(shard, None)  # rows restart from 0
     # index-driven relinquish: drop exactly the shard's keys instead of
     # rebuilding the whole directory (ISSUE 10 satellite)
     for dk in list(store.directory.shard_keys(shard)):

@@ -136,14 +136,25 @@ def shard_digest(store: "KVStore", shard: int) -> str:
     for key, bucket in store.directory.shard_keys(shard):
         tname = store.directory[(key, bucket)][0]
         objs.append((key, split_tier(tname)[0], bucket))
+    if store.cold is not None:
+        # cold keys are shard members like any other: two replicas at
+        # equal clocks must digest identically regardless of which side
+        # happens to hold a key resident.  Their tiered name comes from
+        # the cold REF (never an up-front whole-shard fault-in — the
+        # chunked read below faults each batch in and the post-batch
+        # eviction keeps the resident budget honest throughout)
+        for key, bucket in list(store.cold.shard_cold_keys(shard)):
+            ref = store.cold.refs[(key, bucket)]
+            objs.append((key, split_tier(ref.tname)[0], bucket))
     objs.sort(key=lambda o: _mp.packb([o[0], o[2], o[1]],
                                       use_bin_type=True, default=repr))
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(store.applied_vc[shard],
                                   dtype=np.int64).tobytes())
-    if objs:
-        vals = store.read_values(objs, store.applied_vc[shard])
-        for (key, tname, bucket), v in zip(objs, vals):
+    for lo in range(0, len(objs), 4096):
+        chunk = objs[lo:lo + 4096]
+        vals = store.read_values(chunk, store.applied_vc[shard])
+        for (key, tname, bucket), v in zip(chunk, vals):
             h.update(_mp.packb([_canon(key), bucket, tname, _canon(v)],
                                use_bin_type=True, default=repr))
     return h.hexdigest()
@@ -488,6 +499,56 @@ class KVStore:
         #: decoded bottom (never-written) value per type — served for
         #: keys born after the epoch without any device work
         self._bottom_values: Dict[str, Any] = {}
+        # --- cold tier + incremental-stamp tracking (ISSUE 13) ---------
+        #: ColdTier when beyond-RAM mode is enabled (AntidoteNode
+        #: attaches it); None = every key stays device-resident
+        self.cold = None
+        #: MerkleIndex for split divergence digests (built lazily by the
+        #: replica planes; None until the first tree is requested)
+        self.merkle = None
+        #: (key, bucket) pairs written/born/promoted since the last
+        #: checkpoint capture — the incremental chain's dirty-key window.
+        #: None = untracked overflow: the next stamp must rebase.
+        self.ckpt_dirty_keys: "set | None" = set()
+        #: blob hashes interned in the same window (their WAL records
+        #: fall below the delta's floor, so the link must carry them);
+        #: None = overflow — bounded like the key window above
+        self._ckpt_dirty_blobs: "set | None" = set()
+        #: keys EVICTED to the cold tier in the window: dk -> sidecar
+        #: coords (the delta link records the transition so a composed
+        #: recovery re-registers them cold instead of resurrecting a
+        #: stale resident row over a reused slot)
+        self._ckpt_evicted: Dict[Tuple[Any, str], tuple] = {}
+
+    #: dirty-key windows past this size stop tracking (rebase instead)
+    _CKPT_KEYS_CAP = 262144
+
+    def note_ckpt_dirty(self, dk) -> None:
+        ks = self.ckpt_dirty_keys
+        if ks is not None:
+            ks.add(dk)
+            if len(ks) > self._CKPT_KEYS_CAP:
+                self.ckpt_dirty_keys = None
+
+    def mark_epoch_fallback(self, dk) -> None:
+        """Make every live serving epoch fall back to the locked path
+        for one key — the row-reuse discipline shared by tier promotion,
+        cold eviction and cold fault-in (a frozen buffer may hold the
+        row's previous tenant)."""
+        with self._epoch_lock:
+            eps = list(self._epoch_graveyard)
+            if self.serving_epoch is not None:
+                eps.append(self.serving_epoch)
+        for e in eps:
+            e.promoted.add(dk)
+
+    def drop_cached_value(self, dk) -> None:
+        """Invalidate both decoded-value caches for one key (eviction /
+        range heal: the cached decode may outlive the device row)."""
+        with self._value_cache_lock:
+            self._value_cache.pop(dk, None)
+        with self._snapshot_cache_lock:
+            self.snapshot_cache.pop(dk, None)
 
     def _is_slotted(self, type_name: str) -> bool:
         hit = self._slotted.get(type_name)
@@ -530,12 +591,25 @@ class KVStore:
                     f"not {type_name}"
                 )
             return hit
+        if self.cold is not None and self.cold.is_cold(dk):
+            # cold key: fault the device row back in through the locked
+            # path (typed ColdMiss past the rate cap — never bottom)
+            hit = self.cold.fault_in(dk)
+            if split_tier(hit[0])[0] != type_name:
+                raise TypeError(
+                    f"key {key!r} bucket {bucket!r} already bound to "
+                    f"{hit[0]}, not {type_name}"
+                )
+            return hit
         if not create:
             return None
         shard = key_to_shard(key, bucket, self.cfg.n_shards)
         row = self.table(type_name).alloc_row(shard)
         ent = (type_name, shard, row)
         self.directory[dk] = ent
+        self.note_ckpt_dirty(dk)
+        if self.cold is not None:
+            self.cold.note_birth(dk)
         return ent
 
     def locate_many(self, objects: Sequence[BoundObject]) -> None:
@@ -550,6 +624,16 @@ class KVStore:
         ]
         if not missing:
             return
+        if self.cold is not None:
+            still = []
+            for key, type_name, bucket in missing:
+                if self.cold.is_cold((key, bucket)):
+                    self.cold.fault_in((key, bucket))
+                else:
+                    still.append((key, type_name, bucket))
+            missing = still
+            if not missing:
+                return
         shards = shard_batch(
             [m[0] for m in missing], [m[2] for m in missing],
             self.cfg.n_shards,
@@ -560,6 +644,9 @@ class KVStore:
                 continue
             row = self.table(type_name).alloc_row(int(shard))
             self.directory[dk] = (type_name, int(shard), int(row))
+            self.note_ckpt_dirty(dk)
+            if self.cold is not None:
+                self.cold.note_birth(dk)
 
     # ------------------------------------------------------------------
     def apply_effects(
@@ -655,6 +742,11 @@ class KVStore:
                 locs.append(loc)
                 for h, data in eff.blob_refs:
                     self.blobs.intern_bytes(h, data)
+                    bl = self._ckpt_dirty_blobs
+                    if bl is not None:
+                        bl.add(h)
+                        if len(bl) > self._CKPT_KEYS_CAP:
+                            self._ckpt_dirty_blobs = None
                 if self.log is not None:
                     entries.append((
                         loc[1], eff.key, eff.type_name, eff.bucket,
@@ -682,6 +774,9 @@ class KVStore:
             for i, eff in enumerate(effs):
                 tname_t, shard, row = locs[i]
                 inval.append((eff.key, eff.bucket))
+                self.note_ckpt_dirty((eff.key, eff.bucket))
+                if self.merkle is not None:
+                    self.merkle.mark(shard, (eff.key, eff.bucket))
                 # composite invalidation: a field/membership write kills
                 # the parent map's assembled value (recursively for
                 # nested maps)
@@ -727,6 +822,12 @@ class KVStore:
         # ops — the causal gate trusts it)
         for shard, vc in touched:
             np.maximum(self.applied_vc[shard], vc, out=self.applied_vc[shard])
+        if self.cold is not None and inval:
+            # LRU touch for the written keys, then bounded budget
+            # enforcement — both on the commit path (the caller already
+            # holds the commit lock; eviction mutates tables)
+            self.cold.note_writes(inval)
+            self.cold.maybe_evict()
         return errors, ticket
 
     # ------------------------------------------------------------------
@@ -893,6 +994,8 @@ class KVStore:
             if dk in ep.promoted:
                 return None
             if ent is None:
+                if self.cold is not None and self.cold.is_cold(dk):
+                    return None  # cold key: the locked path faults it in
                 vals.append(self._bottom_value(type_name))
                 continue
             tname_t, shard, row = ent
@@ -1013,6 +1116,9 @@ class KVStore:
                 continue
             ent = self.directory.get(dk)
             if ent is None:
+                if self.cold is not None and self.cold.is_cold(dk):
+                    fallback.append(i)  # faulted in by the locked path
+                    continue
                 vals[i] = self._bottom_value(type_name)
                 continue
             if dk in ep.promoted:
@@ -1288,13 +1394,9 @@ class KVStore:
         # mark the key promoted on every live epoch BEFORE the directory
         # flips: a lock-free epoch reader that sees the new entry also
         # sees the promoted mark and falls back (GIL-ordered)
-        with self._epoch_lock:
-            eps = list(self._epoch_graveyard)
-            if self.serving_epoch is not None:
-                eps.append(self.serving_epoch)
-        for e in eps:
-            e.promoted.add(dk)
+        self.mark_epoch_fallback(dk)
         self.directory[dk] = (tiered_name(base, new_tier), shard, new_row)
+        self.note_ckpt_dirty(dk)
         self.promotions += 1
 
     # ------------------------------------------------------------------
@@ -1353,6 +1455,12 @@ class KVStore:
                                 state[f][j] = rep[f]
             for j, (i, _, _) in enumerate(items):
                 out[i] = {f: x[j] for f, x in state.items()}
+        if self.cold is not None:
+            # a read batch that faulted cold rows in can overshoot the
+            # resident budget (reads never evict mid-batch — a row
+            # located earlier in THIS batch must survive its gather);
+            # here everything is materialized host-side, so re-enforce
+            self.cold.maybe_evict()
         return out  # type: ignore[return-value]
 
     def _bottom_resolved(self, type_name: str) -> Dict[str, np.ndarray]:
@@ -1440,6 +1548,8 @@ class KVStore:
                             }
                         else:
                             out[gi] = rep
+        if self.cold is not None:
+            self.cold.maybe_evict()  # see read_states: post-batch only
         return out  # type: ignore[return-value]
 
     def read_values(
@@ -1533,6 +1643,20 @@ class KVStore:
         #: records replayed by the last recover() call (the recovery
         #: observability satellite; tail-only under a checkpoint floor)
         self.last_recovery_records = 0
+        saved_cap = None
+        if self.cold is not None:
+            # replay is operator-paced: a fault-rate cap sized for
+            # client traffic must not refuse the tail's own fault-ins
+            # (the node would fail to boot at the same record forever)
+            saved_cap, self.cold.fault_rate_cap = \
+                self.cold.fault_rate_cap, 0.0
+        try:
+            return self._recover_inner(track_origin, last_commit)
+        finally:
+            if self.cold is not None and saved_cap is not None:
+                self.cold.fault_rate_cap = saved_cap
+
+    def _recover_inner(self, track_origin, last_commit) -> Dict:
         for shard in range(self.cfg.n_shards):
             batch: List[Effect] = []
             vcs: List[np.ndarray] = []

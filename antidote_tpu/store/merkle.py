@@ -1,0 +1,250 @@
+"""Merkle-split divergence digests (ISSUE 13).
+
+The flat :func:`~antidote_tpu.store.kv.shard_digest` compares ONE hash
+per shard — cheap to compare, but a single corrupted row costs a
+full-shard digest to detect and a whole-store re-install to heal.  This
+module splits each shard's content hash into a fixed-fanout tree over
+``LEAVES`` key buckets:
+
+  * two replicas at EQUAL applied clocks compare roots; on a mismatch
+    the checker walks mismatching children level by level —
+    ``O(fanout · log n)`` hash comparisons localize the diverged key
+    range to one (or a few) leaves;
+  * the heal then fetches ONLY those leaves' key states from the owner
+    (a range-restricted image fetch) instead of quarantining the whole
+    store behind a full re-install.
+
+Leaf digests are pure functions of the CURRENT materialized values
+(same canonical encoding as the flat digest), so they are maintained
+**incrementally**: a write dirties exactly its key's leaf, and a check
+recomputes only the dirty leaves — the steady-state cost of a
+divergence sweep tracks the write working set, not the shard size.
+The flat digest remains the oracle the unit tests pin the tree against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+#: leaf buckets per shard and tree fanout (root -> FANOUT nodes ->
+#: LEAVES leaves with the defaults: depth 2)
+LEAVES = 256
+FANOUT = 16
+
+
+def leaf_of(key, bucket: str, n_leaves: int = LEAVES) -> int:
+    """Stable cross-process leaf index of one key (canonical msgpack
+    bytes -> sha256 -> bucket)."""
+    import msgpack as _mp
+
+    h = hashlib.sha256(_mp.packb([key, bucket], use_bin_type=True,
+                                 default=repr)).digest()
+    return int.from_bytes(h[:4], "big") % n_leaves
+
+
+class MerkleIndex:
+    """Per-store incremental hash tree over each shard's keys.
+
+    Membership (which keys live in which leaf) and leaf digests are
+    maintained lazily: the first :meth:`root` call for a shard builds
+    the partition from the directory + cold index in one pass; after
+    that, writes dirty single leaves via :meth:`mark` and key
+    add/remove flows through :meth:`mark` too (membership is re-derived
+    for dirty leaves only).  Cold keys are members like any other —
+    recomputing a leaf that contains one faults it in through the
+    locked read path (both replicas hash the same VALUES, so a key
+    being cold on one side and resident on the other digests
+    identically)."""
+
+    def __init__(self, store, n_leaves: int = LEAVES, fanout: int = FANOUT):
+        self.store = store
+        self.n_leaves = int(n_leaves)
+        self.fanout = int(fanout)
+        # the walk math assumes a complete tree
+        n = self.fanout
+        while n < self.n_leaves:
+            n *= self.fanout
+        assert n == self.n_leaves, \
+            f"n_leaves ({n_leaves}) must be a power of fanout ({fanout})"
+        #: shard -> list[bytes|None] leaf hashes (None = never computed)
+        self._leaves: Dict[int, List[Optional[bytes]]] = {}
+        #: shard -> set of dirty leaf indices (None = ALL dirty/unbuilt)
+        self._dirty: Dict[int, Optional[Set[int]]] = {}
+        #: shard -> leaf -> set of dks (the membership partition)
+        self._members: Dict[int, List[Set[tuple]]] = {}
+
+    # -- maintenance hooks ----------------------------------------------
+    def mark(self, shard: int, dk) -> None:
+        """A key's value (or membership) changed: dirty its leaf."""
+        shard = int(shard)
+        d = self._dirty.get(shard)
+        if d is None:
+            return  # tree never built for this shard: first root()
+            # builds everything anyway
+        leaf = leaf_of(dk[0], dk[1], self.n_leaves)
+        d.add(leaf)
+        mem = self._members.get(shard)
+        if mem is not None:
+            # membership may have changed (birth/heal-delete): re-derive
+            # the leaf's member set on the next recompute
+            mem[leaf] = None  # type: ignore[call-overload]
+
+    def mark_all(self, shard: int) -> None:
+        """Out-of-band mutation (install, heal, handoff): rebuild the
+        shard's tree from scratch on the next check."""
+        shard = int(shard)
+        self._leaves.pop(shard, None)
+        self._dirty.pop(shard, None)
+        self._members.pop(shard, None)
+
+    def rescan(self, shard: int) -> None:
+        """Force every leaf to rehash from the LIVE device state on the
+        next :meth:`root` (membership kept).  Divergence checks call
+        this before the root compare: silent corruption by definition
+        bypasses the incremental marks, so detection must re-read the
+        data — the tree's win is the O(fanout·log n) COMPARISON walk
+        and the leaf-restricted heal, not skipping the hash of rows it
+        chose to trust."""
+        shard = int(shard)
+        d = self._dirty.get(shard)
+        if d is not None:
+            d.update(range(self.n_leaves))
+
+    # -- (re)computation ------------------------------------------------
+    def _shard_keys(self, shard: int):
+        store = self.store
+        keys = set(store.directory.shard_keys(shard))
+        if store.cold is not None:
+            keys |= set(store.cold.shard_cold_keys(shard))
+        return keys
+
+    def _build_members(self, shard: int) -> List[Set[tuple]]:
+        mem: List[Set[tuple]] = [set() for _ in range(self.n_leaves)]
+        for dk in self._shard_keys(shard):
+            mem[leaf_of(dk[0], dk[1], self.n_leaves)].add(dk)
+        return mem
+
+    def _leaf_digest(self, shard: int, dks) -> bytes:
+        """Hash one leaf's keys + materialized values at the shard's
+        CURRENT applied clock (commit lock held by the caller) — the
+        same canonical form as the flat shard digest."""
+        import msgpack as _mp
+
+        from antidote_tpu.store.kv import _canon, split_tier
+
+        store = self.store
+        objs = []
+        for key, bucket in dks:
+            ent = store.directory.get((key, bucket))
+            if ent is None and store.cold is not None \
+                    and store.cold.is_cold((key, bucket)):
+                ent = store.cold.fault_in((key, bucket), admit=False)
+            if ent is None:
+                continue  # removed concurrently
+            objs.append((key, split_tier(ent[0])[0], bucket))
+        objs.sort(key=lambda o: _mp.packb([o[0], o[2], o[1]],
+                                          use_bin_type=True, default=repr))
+        h = hashlib.sha256()
+        if objs:
+            vals = store.read_values(objs, store.applied_vc[shard])
+            for (key, tname, bucket), v in zip(objs, vals):
+                h.update(_mp.packb([_canon(key), bucket, tname, _canon(v)],
+                                   use_bin_type=True, default=repr))
+        return h.digest()
+
+    def _refresh(self, shard: int) -> List[bytes]:
+        """Bring one shard's leaf hashes current (recompute dirty leaves
+        only).  Caller must hold the commit lock."""
+        shard = int(shard)
+        leaves = self._leaves.get(shard)
+        mem = self._members.get(shard)
+        dirty = self._dirty.get(shard)
+        if leaves is None or mem is None or dirty is None:
+            mem = self._build_members(shard)
+            self._members[shard] = mem
+            leaves = [None] * self.n_leaves
+            self._leaves[shard] = leaves
+            dirty = set(range(self.n_leaves))
+            self._dirty[shard] = dirty
+        for leaf in list(dirty):
+            if mem[leaf] is None:
+                # membership invalidated: re-derive this leaf only
+                mem[leaf] = {
+                    dk for dk in self._shard_keys(shard)
+                    if leaf_of(dk[0], dk[1], self.n_leaves) == leaf
+                }
+            leaves[leaf] = self._leaf_digest(shard, mem[leaf])
+        dirty.clear()
+        return leaves  # type: ignore[return-value]
+
+    # -- tree views -----------------------------------------------------
+    def _levels(self) -> int:
+        n, lv = 1, 0
+        while n < self.n_leaves:
+            n *= self.fanout
+            lv += 1
+        return lv
+
+    def node_hash(self, leaves: List[bytes], level: int, index: int) -> bytes:
+        """Hash of the tree node at (level, index): level 0 = root;
+        level == depth = the leaves themselves (the tree is complete:
+        n_leaves is a power of fanout)."""
+        depth = self._levels()
+        if level >= depth:
+            return leaves[index]
+        h = hashlib.sha256()
+        for child in range(self.fanout):
+            h.update(self.node_hash(leaves, level + 1,
+                                    index * self.fanout + child))
+        return h.digest()
+
+    def root(self, shard: int) -> str:
+        """Current root hash (hex) of one shard — includes the applied
+        clock the same way the flat digest does, so equal clocks +
+        equal state ⇒ equal roots.  Caller holds the commit lock."""
+        leaves = self._refresh(shard)
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(self.store.applied_vc[int(shard)],
+                                      dtype=np.int64).tobytes())
+        h.update(self.node_hash(leaves, 0, 0))
+        return h.hexdigest()
+
+    def children(self, shard: int, level: int, index: int) -> List[str]:
+        """Hex hashes of one node's children — the walk primitive a
+        follower compares against its own.  Caller holds the commit
+        lock; level counts from 0 (root's children = level 1 nodes)."""
+        leaves = self._refresh(shard)
+        out = []
+        for child in range(self.fanout):
+            ci = index * self.fanout + child
+            out.append(self.node_hash(leaves, level + 1, ci).hex())
+        return out
+
+    def leaf_keys(self, shard: int, leaf: int):
+        """The keys currently in one leaf (for the range-restricted
+        heal fetch).  Caller holds the commit lock."""
+        self._refresh(shard)
+        mem = self._members[int(shard)][int(leaf)]
+        if mem is None:
+            mem = {
+                dk for dk in self._shard_keys(int(shard))
+                if leaf_of(dk[0], dk[1], self.n_leaves) == int(leaf)
+            }
+            self._members[int(shard)][int(leaf)] = mem
+        return set(mem)
+
+    def depth(self) -> int:
+        return self._levels()
+
+
+def get_merkle(store) -> MerkleIndex:
+    """The store's (lazily-built) divergence tree."""
+    if store.merkle is None:
+        store.merkle = MerkleIndex(store)
+    return store.merkle
+
+
+__all__ = ["MerkleIndex", "get_merkle", "leaf_of", "LEAVES", "FANOUT"]

@@ -203,6 +203,13 @@ class TypedTable:
         self.n_shards = n_shards or cfg.n_shards
         self.sharding = sharding
         self.used_rows = np.zeros((self.n_shards,), np.int64)
+        #: per-shard reusable rows freed by the cold tier's guarded evict
+        #: (store/coldtier.py) — ``alloc_row`` pops here before advancing
+        #: the high-water mark, which is what keeps device residency
+        #: BOUNDED under a beyond-RAM keyspace instead of growing the
+        #: table forever.  ``used_rows`` stays the row-extent high-water
+        #: mark (freed rows sit below it holding zeros).
+        self.free_rows: Dict[int, list] = {}
         self.next_seq = 1
         self._resolved_fns: Dict[bool, Any] = {}
         self._resolved_flat_fns: Dict[bool, Any] = {}
@@ -283,6 +290,26 @@ class TypedTable:
         self.on_serving_invalidate = None
         self._serving_conservative = False
         self._freeze_scatter_fns: Dict[int, Any] = {}
+        #: (shard, row) pairs written since the last CHECKPOINT capture —
+        #: the incremental-chain stamp's dirty window (independent of the
+        #: serving-freeze windows above, which publishes consume on their
+        #: own cadence).  None = untracked (overflow past the cap or an
+        #: out-of-band mutation): the next stamp must be a full rebase.
+        self._ckpt_dirty: "set | None" = set()
+
+    #: checkpoint dirty windows larger than this stop tracking: a delta
+    #: link that would carry most of the table has no cost advantage
+    #: over a rebase, and the set itself must stay bounded
+    _CKPT_DIRTY_CAP = 262144
+
+    def take_ckpt_dirty(self) -> "set | None":
+        """Consume the checkpoint dirty window (called under the commit
+        lock by the stamp capture): returns the written (shard, row) set
+        since the previous capture, or None when a rebase is required;
+        the window restarts empty either way."""
+        out = self._ckpt_dirty
+        self._ckpt_dirty = set()
+        return out
 
     # ------------------------------------------------------------------
     # serving-epoch double buffer (lock-free wire reads)
@@ -292,7 +319,9 @@ class TypedTable:
     _SERVING_DIRTY_CAP = 8192
 
     def note_serving_touch(self, shards, rows) -> None:
-        """Record appended rows for the incremental serving freeze."""
+        """Record appended rows for the incremental serving freeze AND
+        the incremental checkpoint stamp (separate windows, separate
+        consumers)."""
         pairs = list(zip(shards.tolist(), rows.tolist()))
         for attr in ("_serving_dirty", "_serving_spare_dirty"):
             s = getattr(self, attr)
@@ -301,6 +330,11 @@ class TypedTable:
             s.update(pairs)
             if len(s) > self._SERVING_DIRTY_CAP:
                 setattr(self, attr, None)
+        ck = self._ckpt_dirty
+        if ck is not None:
+            ck.update(pairs)
+            if len(ck) > self._CKPT_DIRTY_CAP:
+                self._ckpt_dirty = None
 
     def serving_slot(self):
         """The current frozen serving buffer (or None before any freeze)."""
@@ -327,6 +361,9 @@ class TypedTable:
         #: must report its write-set as UNKNOWN (touched=None) so cache
         #: entries cannot revalidate across it
         self._serving_conservative = True
+        # same for the checkpoint window: a handoff install / promotion
+        # moved rows the window didn't see — the next stamp must rebase
+        self._ckpt_dirty = None
         cb = self.on_serving_invalidate
         if cb is not None:
             cb()
@@ -452,11 +489,183 @@ class TypedTable:
     # row allocation / growth
     # ------------------------------------------------------------------
     def alloc_row(self, shard: int) -> int:
+        free = self.free_rows.get(shard)
+        if free:
+            # evicted row reuse: the guarded evict zeroed the row's whole
+            # device state, so the new occupant starts from bottom exactly
+            # like a fresh row (the evictor also marked the row touched +
+            # epoch-promoted, so no frozen buffer serves stale bytes)
+            return free.pop()
         if self.used_rows[shard] == self.n_rows:
             self._grow()
         r = int(self.used_rows[shard])
         self.used_rows[shard] += 1
         return r
+
+    def resident_rows(self) -> int:
+        """Device rows currently holding key state: the allocation
+        high-water mark minus the freed (evicted, reusable) rows — the
+        quantity the cold tier's ``--resident-rows`` budget bounds."""
+        return int(self.used_rows.sum()) - sum(
+            len(v) for v in self.free_rows.values())
+
+    @functools.cached_property
+    def _evict_clear_fn(self):
+        """One-launch guarded row clear (cold-tier evict): zero every
+        device array at the given (shard, row) pairs.  Donated in place;
+        padding uses shard index P (scatter drops)."""
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(tree, ss, rr):
+            return jax.tree.map(
+                lambda x: x.at[ss, rr].set(
+                    jnp.zeros(x.shape[2:], x.dtype), mode="drop"),
+                tree,
+            )
+
+        return fn
+
+    def evict_rows(self, shards, rows) -> None:
+        """The GUARDED device-buffer drop of the cold tier (tools/lint.py
+        enforces that nothing outside store/coldtier.py calls this
+        without an ``# evict-ok:`` note): clear the rows' whole device
+        state — head, snapshot versions, op ring — and push them onto the
+        per-shard free lists for reuse.  The CALLER owns the correctness
+        obligations: the rows' state must be covered by a retained
+        checkpoint sidecar, the owning keys unbound from the directory,
+        and every live serving epoch told to fall back for them."""
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        m = len(rows)
+        if m == 0:
+            return
+        mb = _bucket(m, self.cfg.batch_buckets)
+        ss = np.full(mb, self.n_shards, np.int64)
+        rr = np.zeros(mb, np.int64)
+        ss[:m] = shards
+        rr[:m] = rows
+        tree = {
+            "snap": self.snap, "head": self.head,
+            "snap_vc": self.snap_vc, "snap_seq": self.snap_seq,
+            "ops_a": self.ops_a, "ops_b": self.ops_b,
+            "ops_vc": self.ops_vc, "ops_origin": self.ops_origin,
+            "head_vc": self.head_vc,
+        }
+        tree = self._evict_clear_fn(tree, ss, rr)
+        self.snap, self.head = tree["snap"], tree["head"]
+        self.snap_vc, self.snap_seq = tree["snap_vc"], tree["snap_seq"]
+        self.ops_a, self.ops_b = tree["ops_a"], tree["ops_b"]
+        self.ops_vc, self.ops_origin = tree["ops_vc"], tree["ops_origin"]
+        self.head_vc = tree["head_vc"]
+        self.n_ops[shards, rows] = 0
+        self.slots_ub[shards, rows] = 0
+        for s, r in zip(shards.tolist(), rows.tolist()):
+            self.free_rows.setdefault(s, []).append(int(r))
+        # the cleared rows must not serve from any frozen buffer: the
+        # next publish re-freezes them (callers additionally mark the
+        # evicted keys promoted on live epochs for the interim)
+        self.note_serving_touch(shards, rows)
+        # older whole-head epoch copies (the VC-pinned ladder) still hold
+        # the evicted bytes; they'd serve them for the row's NEXT tenant
+        self.epochs.clear()
+
+    @functools.cached_property
+    def _cold_install_fn(self):
+        """One-launch cold fault-in / range-heal row install: set the
+        head fields + head_vc at (shard, row) pairs and seed ONE snapshot
+        version from the installed head (same discipline as
+        checkpoint.install_image: versioned reads at clocks ≥ head_vc
+        fold the empty ring on this base exactly; reads below surface the
+        compaction horizon instead of a silently wrong value)."""
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(tree, ss, rr, head_rows, hvc_rows, seqs):
+            out = dict(tree)
+            out["head"] = {
+                f: x.at[ss, rr].set(head_rows[f], mode="drop")
+                for f, x in tree["head"].items()
+            }
+            out["snap"] = {
+                f: x.at[ss, rr, 0].set(head_rows[f], mode="drop")
+                for f, x in tree["snap"].items()
+            }
+            out["head_vc"] = tree["head_vc"].at[ss, rr].set(
+                hvc_rows, mode="drop")
+            out["snap_vc"] = tree["snap_vc"].at[ss, rr, 0].set(
+                hvc_rows, mode="drop")
+            out["snap_seq"] = tree["snap_seq"].at[ss, rr, 0].set(
+                seqs, mode="drop")
+            return out
+
+        return fn
+
+    def install_rows(self, shards, rows, head_rows, head_vc_rows) -> None:
+        """Install per-row head states (cold-tier fault-in / Merkle range
+        heal).  ``head_rows`` maps field -> [M, *field_shape] host
+        arrays; the rows must be freshly-allocated or evict-cleared (the
+        ring is empty, so the seeded snapshot version is the row's entire
+        retained history)."""
+        shards = np.asarray(shards, np.int64)
+        rows = np.asarray(rows, np.int64)
+        m = len(rows)
+        if m == 0:
+            return
+        mb = _bucket(m, self.cfg.batch_buckets)
+        pad = mb - m
+        ss = np.concatenate([shards, np.full(pad, self.n_shards, np.int64)])
+        rr = np.concatenate([rows, np.zeros(pad, np.int64)])
+        hr = {}
+        for f, x in self.head.items():
+            src = np.asarray(head_rows[f])
+            buf = np.zeros((mb,) + x.shape[2:], np.dtype(x.dtype))
+            buf[:m] = src
+            hr[f] = buf
+        hvc = np.zeros((mb, self.head_vc.shape[-1]), np.int32)
+        hvc[:m] = np.asarray(head_vc_rows, np.int32)
+        seqs = np.zeros(mb, np.int64)
+        seqs[:m] = np.arange(self.next_seq, self.next_seq + m)
+        self.next_seq += m
+        tree = {
+            "snap": self.snap, "head": self.head,
+            "snap_vc": self.snap_vc, "snap_seq": self.snap_seq,
+            "head_vc": self.head_vc,
+        }
+        tree = self._cold_install_fn(tree, ss, rr, hr, hvc, seqs)
+        self.snap, self.head = tree["snap"], tree["head"]
+        self.snap_vc, self.snap_seq = tree["snap_vc"], tree["snap_seq"]
+        self.head_vc = tree["head_vc"]
+        self.n_ops[shards, rows] = 0
+        np.maximum(self.max_commit_vc,
+                   np.asarray(head_vc_rows, np.int32).max(axis=0)
+                   if m else self.max_commit_vc,
+                   out=self.max_commit_vc)
+        self.note_serving_touch(shards, rows)
+        self.epochs.clear()
+
+    @functools.cached_property
+    def _gather_rows_fn(self):
+        """Dispatch-only gather of (head, head_vc) rows — the delta
+        checkpoint's capture primitive: launched under the commit-lock
+        barrier, materialized outside it."""
+        @jax.jit
+        def fn(head, head_vc, ss, rr):
+            return ({f: x[ss, rr] for f, x in head.items()},
+                    head_vc[ss, rr])
+
+        return fn
+
+    def gather_rows_dispatch(self, shards, rows):
+        """Launch a (head, head_vc) gather for the given rows; returns
+        DEVICE handles padded to a batch bucket (the caller slices to
+        the true length after materializing off the lock — padding
+        keeps each delta stamp from minting a fresh XLA trace for its
+        particular dirty-row count)."""
+        m = len(rows)
+        mb = _bucket(max(m, 1), self.cfg.batch_buckets)
+        ss = np.zeros(mb, np.int64)
+        rr = np.zeros(mb, np.int64)
+        ss[:m] = np.minimum(np.asarray(shards, np.int64),
+                            self.n_shards - 1)
+        rr[:m] = np.minimum(np.asarray(rows, np.int64), self.n_rows - 1)
+        return self._gather_rows_fn(self.head, self.head_vc, ss, rr)
 
     def _grow(self):
         new_n = self.n_rows * 2
@@ -481,8 +690,13 @@ class TypedTable:
         self.slots_ub = np.pad(self.slots_ub, ((0, 0), (0, new_n - self.n_rows)))
         self.n_rows = new_n
         # epoch copies still have the old row extent — row indices past it
-        # would gather-clip onto the wrong key
+        # would gather-clip onto the wrong key.  The CHECKPOINT dirty
+        # window survives: growth moves no row and changes no content, so
+        # the incremental stamp's tracking stays exact (new rows enter it
+        # through their first touch)
+        ck = self._ckpt_dirty
         self.invalidate_epochs()
+        self._ckpt_dirty = ck
 
     # ------------------------------------------------------------------
     # serving epochs (read-while-write double buffer)

@@ -166,6 +166,12 @@ class TransactionManager:
         #: prunes its committed_tx ETS against the stable time the same
         #: way, /root/reference/src/clocksi_vnode.erl:671-678)
         self.committed_keys: Dict[Tuple[Any, str], int] = {}
+        #: certification stamps touched since the last checkpoint
+        #: capture — the incremental chain's committed-keys delta window
+        #: (consumed by Checkpointer._consume_windows_locked).  None =
+        #: overflow past the cap (the next stamp rebases) — without it a
+        #: long-running NON-checkpointing node would grow this forever
+        self.ckpt_dirty_committed: "set | None" = set()
         #: open txid -> its own-lane snapshot (the GC floor)
         self._open_snaps: Dict[int, int] = {}
         self._cert_gc_every = 1024
@@ -841,6 +847,12 @@ class TransactionManager:
                     if ck not in stamped:
                         stamped[ck] = self.committed_keys.get(ck)
                     self.committed_keys[ck] = self.commit_counter
+                    ckd = self.ckpt_dirty_committed
+                    if ckd is not None:
+                        ckd.add(ck)
+                        if len(ckd) > 262144:  # bounded like the
+                            # store's key window: overflow → rebase
+                            self.ckpt_dirty_committed = None
                     last_seen[ck] = self.commit_counter
             pend.append((len(out), txn, commit_vc, effects, stamped,
                          self.commit_counter))

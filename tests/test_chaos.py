@@ -1464,6 +1464,215 @@ def test_hashed_fleet_clustered_owner_sigkill_and_shard_move(tmp_path):
 # ---------------------------------------------------------------------------
 # long soak (excluded from tier-1 via -m 'not slow'; run with `make chaos`)
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# scenario 17: beyond-RAM survival under fire (ISSUE 13) — console serve
+# with a cold tier + incremental checkpoint chains under a seeded write
+# storm over a keyspace larger than the resident budget; SIGKILL lands
+# mid-chain-stamp (fault-stretched window), ONE mid-chain link is
+# corrupted on disk, and a follower gets a single row byte flipped.
+# Contract: resident rows stay bounded by --resident-rows through the
+# storm AND through recovery, acked ⊆ recovered ⊆ attempted, two
+# recoveries are byte-identical despite the corrupt link (prefix + WAL
+# tail fallback), and the follower's divergence heals through the
+# Merkle range fetch touching ONLY the diverged leaf — no re-bootstrap.
+# ---------------------------------------------------------------------------
+def test_coldtier_chain_storm_sigkill_corrupt_link_and_merkle_heal(tmp_path):
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.interdc import FollowerReplica, LoopbackHub
+    from antidote_tpu.log import checkpoint as ckpt
+    from antidote_tpu.proto.client import AntidoteClient
+
+    N_KEYS = 96          # keyspace per the whole storm
+    BUDGET = 40          # resident-rows budget (≪ keyspace)
+    rcfg = AntidoteConfig(n_shards=2, max_dcs=2, wal_segments=3,
+                          keys_per_table=32)
+    log_dir = str(tmp_path / "wal")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # stretch every stamp's write window so the SIGKILL lands
+        # mid-chain-stamp regardless of scheduling
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 17, "rules": [
+            {"site": "ckpt.write", "action": "delay", "arg": 0.1},
+        ]}),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", "2", "--max-dcs", "2",
+         "--log-dir", log_dir, "--sync-log", "--wal-segments", "3",
+         "--keys-per-table", "32",
+         "--checkpoint-interval-s", "0.25",
+         "--checkpoint-rebase-every", "3",
+         "--resident-rows", str(BUDGET)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True,
+    )
+    acked = [0] * N_KEYS
+    attempted = [0] * N_KEYS
+    errs: list = []
+    max_resident = [0]
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        stop = threading.Event()
+        # one populate sweep over the WHOLE beyond-budget keyspace: the
+        # tail goes cold once the first full image covers it, while the
+        # storm below keeps a hot set (smaller than the budget) dirty
+        cpop = AntidoteClient(info["host"], info["port"])
+        for k in range(N_KEYS):
+            attempted[k] += 1
+            cpop.update_objects([(k, "counter_pn", "b",
+                                  ("increment", 1))])
+            acked[k] += 1
+        cpop.close()
+
+        def writer(base):
+            try:
+                c = AntidoteClient(info["host"], info["port"])
+                n = 0
+                while not stop.is_set():
+                    k = base + n % 8  # 24 hot keys across 3 writers
+                    n += 1
+                    attempted[k] += 1
+                    c.update_objects([(k, "counter_pn", "b",
+                                       ("increment", 1))])
+                    acked[k] += 1
+            except (ConnectionError, OSError):
+                pass  # the kill severed the socket mid-request
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=writer, args=(i * 8,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        # run until the chain has a full image + at least one delta AND
+        # the cold tier is actively bounding residency under the storm
+        mon = AntidoteClient(info["host"], info["port"])
+        deadline = time.monotonic() + 60.0
+        settled_at = None
+        while True:
+            assert time.monotonic() < deadline, "chain never formed"
+            st = mon.node_status()
+            ck = st.get("checkpoint", {})
+            cold = st.get("cold_tier", {})
+            resident = cold.get("resident_rows", 0)
+            # the budget becomes enforceable once a full image covers
+            # the populated tail (keys written since a stamp are not
+            # evictable until the next stamp — by design: eviction can
+            # never lose a write); from the first settled observation
+            # onward, the storm's hot set (< budget) must keep
+            # residency bounded
+            if settled_at is None:
+                if resident and resident <= BUDGET:
+                    settled_at = time.monotonic()
+            else:
+                max_resident[0] = max(max_resident[0], resident)
+            if settled_at is not None \
+                    and time.monotonic() - settled_at >= 1.0 \
+                    and (ck.get("last_id") or 0) >= 2 \
+                    and (ck.get("chain_len") or 0) >= 1 \
+                    and cold.get("cold_keys", 0) > 0 \
+                    and sum(acked) >= 200:
+                break
+            time.sleep(0.05)
+        # bounded RSS through the storm's steady state: residency tracks
+        # the budget (hot-set writes + one commit batch of slack)
+        assert 0 < max_resident[0] <= BUDGET + 32, max_resident[0]
+        mon.close()
+        time.sleep(0.3)  # land inside a stretched stamp window
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert sum(acked) > 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # corrupt ONE mid-chain link on disk (bit rot between crash and
+    # recovery); recovery must fall back to the prefix + WAL tail
+    cks = ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))
+    deltas = [(i, p) for i, p in cks
+              if ckpt.manifest_kind(ckpt.load_manifest(p) or {}) == "delta"]
+    if deltas:
+        victim = deltas[len(deltas) // 2][1]
+        with open(os.path.join(victim, "image.bin"), "r+b") as f:
+            f.seek(12)
+            f.write(b"\xff\xff\xff\xff")
+    objs = [(k, "counter_pn", "b") for k in range(N_KEYS)]
+    recovered = []
+    for _ in range(2):  # two independent recoveries, byte-identical
+        node = AntidoteNode(rcfg, log_dir=log_dir, recover=True,
+                            resident_rows=BUDGET)
+        # bounded recovery: the budget pass re-evicts everything the
+        # surviving chain covers; only rows the corrupt link's
+        # truncation left uncovered (WAL-tail-overlaid) may exceed the
+        # budget — never the whole keyspace
+        assert node.store.cold.resident_rows() < N_KEYS
+        assert len(node.store.cold.cold_set) > 0
+        vals, _ = node.read_objects(objs)  # faults cold keys in, exact
+        recovered.append({
+            "vals": vals,
+            "op_ids": node.store.log.op_ids.tolist(),
+            "seqs": node.store.log.seqs.tolist(),
+            "stable": [int(x) for x in node.stable_vc()],
+        })
+        node.store.log.close()
+    assert recovered[0] == recovered[1], "recoveries diverged"
+    vals = recovered[0]["vals"]
+    for k in range(N_KEYS):
+        assert acked[k] <= vals[k] <= attempted[k], (
+            f"k{k}: acked={acked[k]} recovered={vals[k]} "
+            f"attempted={attempted[k]}")
+    # ---- follower leg: flip ONE row byte, heal ONLY that range --------
+    hub = LoopbackHub()
+    owner = AntidoteNode(rcfg, log_dir=log_dir, recover=True)
+    orep = DCReplica(owner, hub, "dc0")
+    orep.restore_from_log()
+    owner.checkpoint_now(full=True)
+    fnode = AntidoteNode(rcfg, log_dir=str(tmp_path / "fol"))
+    fol = FollowerReplica(fnode, hub, "f17",
+                          owner_client_addr=("h", 1), fabric_id=177)
+    fol.attach(orep.descriptor())
+    for _ in range(40):
+        orep.heartbeat()
+        hub.pump()
+        if (fnode.store.stable_vc() >= owner.store.dc_max_vc()).all():
+            break
+    assert all(v == "ok" for v in fol.check_divergence().values())
+    victim_key = next(k for k in range(N_KEYS) if vals[k] > 0)
+    tname, shard, row = fnode.store.directory[(victim_key, "b")]
+    t = fnode.store.tables[tname]
+    f0 = next(iter(t.head))
+    t.head[f0] = t.head[f0].at[shard, row].set(10**6)
+    fnode.store.drop_cached_value((victim_key, "b"))
+    # snapshot every OTHER row of the shard: the heal must not touch it
+    others_before = np.asarray(t.head[f0]).copy()
+    boots_before = fol.boots
+    res = fol.check_divergence([shard])
+    assert res == {shard: "mismatch"}, res
+    assert fnode.metrics.divergence_heals.value(mode="range") == 1
+    assert fnode.metrics.divergence_heals.value(mode="image") == 0
+    assert fol.boots == boots_before, "range heal must not re-bootstrap"
+    got, _ = fnode.read_objects([(victim_key, "counter_pn", "b")])
+    assert got == [vals[victim_key]]
+    # locality: only the flipped row changed; every other row of the
+    # table is byte-identical to its pre-heal state
+    others_after = np.asarray(t.head[f0])
+    mask = np.ones(others_after.shape, bool)
+    mask[shard, row] = False
+    assert (others_after[mask] == others_before[mask]).all()
+    assert all(v == "ok" for v in fol.check_divergence().values())
+    owner.store.log.close(), fnode.store.log.close()
+
+
 @pytest.mark.slow
 def test_storm_soak_many_rounds(cfg):
     """A longer seeded storm across 3 DCs with partitions opening and

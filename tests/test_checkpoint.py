@@ -129,6 +129,7 @@ def test_fast_path_replays_only_the_tail(dcfg, tmp_path):
 def test_wal_bounded_under_sustained_writes(dcfg, tmp_path):
     log_dir = str(tmp_path / "wal")
     node = AntidoteNode(dcfg, log_dir=log_dir)
+    node.start_checkpointer(interval_s=0.0, rebase_every=2)
     sizes = []
     for round_ in range(6):
         for i in range(40):
@@ -136,14 +137,23 @@ def test_wal_bounded_under_sustained_writes(dcfg, tmp_path):
                 (i % 8, "counter_pn", "b", ("increment", 1))])
         node.checkpoint_now()
         sizes.append(wal_bytes(log_dir))
-    # reclaim keeps the retention window's tail (retain=2 → the last
-    # two inter-checkpoint windows) but never the whole history: the
-    # steady state is flat while total writes grow linearly
-    assert sizes[-1] <= sizes[1] * 2, sizes
+    # delta links advance the replay floor but only REBASES reclaim (a
+    # corrupt mid-chain link must always fall back to full + tail): at
+    # rebase_every=2 the steady state stays flat while total writes
+    # grow linearly
+    assert sizes[-1] <= sizes[1] * 3.5, sizes
     assert node.metrics.wal_reclaimed.value() > 0
     assert node.checkpointer.reclaimed_total > 0
-    # retention: at most 2 images (default) remain published
-    assert len(ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))) == 2
+    # retention: 2 FULL images (default) + the live chain's links
+    published = [ckpt.load_manifest(p) for _i, p in
+                 ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))]
+    fulls = [m for m in published if ckpt.manifest_kind(m) == "full"]
+    assert len(fulls) == 2
+    # every surviving delta link sits ABOVE the newest full (older ones
+    # were swept by the rebase that covered them)
+    newest_full = max(m["id"] for m in fulls)
+    assert all(m["id"] > newest_full for m in published
+               if ckpt.manifest_kind(m) == "delta")
     vals, _ = node.read_objects([(i, "counter_pn", "b") for i in range(8)])
     assert vals == [30] * 8
     node.store.log.close()
@@ -496,6 +506,165 @@ def test_compacted_import_checkpoint_barrier_survives_sigkill(dcfg,
         assert vals == [7], vals
         d2.store.log.close()
     src.store.log.close()
+
+
+# ---------------------------------------------------------------------------
+# incremental chains (ISSUE 13): compose / rebase / corrupt-link matrix
+# ---------------------------------------------------------------------------
+def _chain_store(dcfg, tmp_path, links=3, writes_per_link=6):
+    """full image + ``links`` delta links + a WAL tail; returns
+    (log_dir, oracle values dict)."""
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    node.start_checkpointer(interval_s=0.0, rebase_every=64)
+    vals = {}
+    for i in range(12):
+        node.update_objects([(i, "counter_pn", "b", ("increment", i + 1))])
+        vals[i] = i + 1
+    node.checkpoint_now(full=True)
+    for link in range(links):
+        for j in range(writes_per_link):
+            k = (link * writes_per_link + j) % 12
+            node.update_objects([(k, "counter_pn", "b", ("increment", 10))])
+            vals[k] += 10
+        s = node.checkpoint_now()
+        assert s["kind"] == "delta", s
+    # WAL tail above the chain head
+    node.update_objects([(1, "counter_pn", "b", ("increment", 7))])
+    vals[1] += 7
+    node.store.log.close()
+    return log_dir, vals
+
+
+def _assert_recovers(dcfg, log_dir, vals, rounds=2):
+    for _ in range(rounds):
+        n = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+        got, _ = n.read_objects([(i, "counter_pn", "b")
+                                 for i in sorted(vals)])
+        assert got == [vals[i] for i in sorted(vals)], got
+        dig = digest(n)
+        n.store.log.close()
+    return dig
+
+
+def test_chain_composes_byte_identical(dcfg, tmp_path):
+    """full + deltas + tail compose to the exact live state, twice."""
+    log_dir, vals = _chain_store(dcfg, tmp_path)
+    chain = ckpt.load_chain(log_dir)
+    assert chain is not None and len(chain[2]) == 3
+    d1 = _assert_recovers(dcfg, log_dir, vals)
+    d2 = _assert_recovers(dcfg, log_dir, vals)
+    assert d1 == d2
+
+
+def test_corrupt_mid_chain_link_falls_back_to_prefix(dcfg, tmp_path):
+    """Bit-rot ONE mid-chain link: recovery composes the prefix before
+    it and replays a LONGER WAL tail — byte-identical, never lost."""
+    log_dir, vals = _chain_store(dcfg, tmp_path)
+    cks = ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))
+    deltas = [(i, p) for i, p in cks
+              if ckpt.manifest_kind(ckpt.load_manifest(p)) == "delta"]
+    mid = deltas[1]  # the MIDDLE link of the 3-link chain
+    with open(os.path.join(mid[1], "image.bin"), "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff\xff\xff")
+    chain = ckpt.load_chain(log_dir)
+    assert len(chain[2]) == 1  # stops before the corrupt link
+    _assert_recovers(dcfg, log_dir, vals)
+
+
+def test_missing_mid_chain_link_falls_back_to_prefix(dcfg, tmp_path):
+    """A DELETED mid-chain link breaks parent linkage the same way."""
+    import shutil
+
+    log_dir, vals = _chain_store(dcfg, tmp_path)
+    cks = ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))
+    deltas = [(i, p) for i, p in cks
+              if ckpt.manifest_kind(ckpt.load_manifest(p)) == "delta"]
+    shutil.rmtree(deltas[1][1])
+    chain = ckpt.load_chain(log_dir)
+    assert len(chain[2]) == 1
+    _assert_recovers(dcfg, log_dir, vals)
+
+
+def test_delta_stamp_cost_tracks_dirty_rows(dcfg, tmp_path):
+    """The incremental-cost contract: a delta link's size and row count
+    scale with the dirty set, not the table extent."""
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    node.start_checkpointer(interval_s=0.0, rebase_every=64)
+    for i in range(200):
+        node.update_objects([(i, "counter_pn", "b", ("increment", 1))])
+    full = node.checkpoint_now(full=True)
+    node.update_objects([(3, "counter_pn", "b", ("increment", 1))])
+    small = node.checkpoint_now()
+    assert small["kind"] == "delta"
+    assert small["n_rows"] == 1
+    assert small["image_bytes"] < full["image_bytes"] / 5
+    for i in range(50):
+        node.update_objects([(i, "counter_pn", "b", ("increment", 1))])
+    bigger = node.checkpoint_now()
+    assert bigger["kind"] == "delta"
+    assert bigger["n_rows"] == 50
+    assert small["image_bytes"] < bigger["image_bytes"] \
+        < full["image_bytes"]
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    got, _ = n2.read_objects([(i, "counter_pn", "b") for i in range(200)])
+    want = [1 + (1 if i < 50 else 0) + (1 if i == 3 else 0)
+            for i in range(200)]
+    assert got == want
+    n2.store.log.close()
+
+
+def test_failed_delta_stamp_forces_rebase(dcfg, tmp_path):
+    """A failed stamp consumed the dirty windows — the NEXT stamp must
+    be a full rebase (nothing can fall through the gap)."""
+    log_dir = str(tmp_path / "wal")
+    node = AntidoteNode(dcfg, log_dir=log_dir)
+    node.start_checkpointer(interval_s=0.0, rebase_every=64)
+    populate(node)
+    node.checkpoint_now(full=True)
+    node.update_objects([("c", "counter_pn", "b", ("increment", 5))])
+    faults.install(faults.FaultPlan(seed=9).enospc("ckpt.write", times=1))
+    with pytest.raises(ckpt.CheckpointError):
+        node.checkpoint_now()
+    faults.uninstall()
+    assert node.checkpointer.force_rebase is True
+    s = node.checkpoint_now()
+    assert s["kind"] == "full"
+    node.update_objects([("c", "counter_pn", "b", ("increment", 1))])
+    want, _ = node.read_objects([("c", "counter_pn", "b")])
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    got, _ = n2.read_objects([("c", "counter_pn", "b")])
+    assert got == want
+    n2.store.log.close()
+
+
+def test_scrubber_retires_corrupt_link_and_forces_rebase(dcfg, tmp_path):
+    """The background scrub finds bit rot BEFORE a restart does: the
+    corrupt delta link is retired, a rebase forced, the metric bumped —
+    and the store still recovers byte-identical afterwards."""
+    log_dir, vals = _chain_store(dcfg, tmp_path)
+    n = AntidoteNode(dcfg, log_dir=log_dir, recover=True)
+    n.start_checkpointer(interval_s=0.0, rebase_every=64)
+    cks = ckpt.list_checkpoints(ckpt.checkpoint_root(log_dir))
+    deltas = [(i, p) for i, p in cks
+              if ckpt.manifest_kind(ckpt.load_manifest(p)) == "delta"]
+    with open(os.path.join(deltas[1][1], "image.bin"), "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff\xff\xff")
+    out = n.checkpointer.scrub()
+    assert out["corrupt"] == 1 and out["ok"] >= 2
+    assert n.metrics.checkpoint_scrub.value(result="corrupt") == 1
+    assert not os.path.isdir(deltas[1][1])  # retired on the spot
+    assert n.checkpointer.force_rebase is True
+    s = n.checkpoint_now()
+    assert s["kind"] == "full"
+    assert n.checkpointer.scrub()["corrupt"] == 0
+    n.store.log.close()
+    _assert_recovers(dcfg, log_dir, vals)
 
 
 def test_checkpoint_now_over_the_wire(dcfg, tmp_path):

@@ -1,0 +1,469 @@
+"""Beyond-RAM survival (ISSUE 13): cold tier, incremental checkpoint
+chains, and Merkle-split divergence repair.
+
+Three invariant families:
+
+  * **cold tier** — eviction only ever drops rows whose live head_vc is
+    byte-equal to the anchor sidecar's stamp; reads fault evicted rows
+    back in EXACTLY (same values at the same VC stamps); refusals (rate
+    cap, injected I/O fault, CRC failure) are typed ColdMiss — never a
+    bottom read; the resident budget holds under sustained writes.
+  * **Merkle tree** — root equality tracks the flat shard_digest oracle;
+    a single-row flip localizes to exactly one leaf in O(fanout·depth)
+    hash comparisons and heals by a range-restricted fetch (no
+    re-bootstrap); an unsubscribed peer lane types as ``unsubscribed``.
+  * **chains** — full + delta compose byte-identical to the all-full
+    oracle; a corrupt/missing mid-chain link falls back to the prefix +
+    a longer WAL tail; the scrubber retires corrupt links and forces a
+    rebase.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from antidote_tpu import faults
+from antidote_tpu.api import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.log import checkpoint as ckpt
+from antidote_tpu.overload import ColdMiss
+from antidote_tpu.store.kv import shard_digest
+from antidote_tpu.store.merkle import MerkleIndex, get_merkle, leaf_of
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture
+def dcfg():
+    return AntidoteConfig(
+        n_shards=4, max_dcs=3, ops_per_key=8, snap_versions=2,
+        set_slots=8, mv_slots=4, rga_slots=16, keys_per_table=64,
+        batch_buckets=(16, 64), wal_segments=3,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.uninstall()
+
+
+def populate(node, n, start=0, mult=1):
+    for i in range(start, start + n):
+        node.update_objects([(i, "counter_pn", "b",
+                              ("increment", (i + 1) * mult))])
+
+
+# ---------------------------------------------------------------------------
+# cold tier
+# ---------------------------------------------------------------------------
+def test_evict_fault_read_roundtrip_exact_vc(dcfg, tmp_path):
+    """Evicted keys fault back in byte-exact: values AND the head VC
+    stamps (the exactness the divergence digests then depend on)."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 32)
+    vcs = {}
+    for i in range(32):
+        tname, shard, row = node.store.directory[(i, "b")]
+        t = node.store.tables[tname]
+        vcs[i] = np.asarray(t.head_vc[shard, row]).copy()
+    node.checkpoint_now()
+    cold = node.store.cold
+    cold.budget = 8
+    evicted = cold.evict_now(max_rows=1024)
+    assert evicted >= 24, evicted
+    assert cold.resident_rows() <= 8
+    assert len(cold.cold_set) == evicted
+    # a cold key has NO directory entry (epoch fast paths fall back)
+    cold_key = next(iter(cold.cold_set))[0]
+    assert (cold_key, "b") not in node.store.directory
+    # read it back: fault-in restores value + exact head VC stamp
+    vals, _ = node.read_objects([(cold_key, "counter_pn", "b")])
+    assert vals == [cold_key + 1]
+    tname, shard, row = node.store.directory[(cold_key, "b")]
+    t = node.store.tables[tname]
+    assert (np.asarray(t.head_vc[shard, row]) == vcs[cold_key]).all()
+    assert cold.faults == 1
+    assert node.metrics.coldtier_events.value(event="fault") == 1
+    # every key still reads exact (bulk fault-in)
+    vals, _ = node.read_objects([(i, "counter_pn", "b")
+                                 for i in range(32)])
+    assert vals == [i + 1 for i in range(32)]
+    node.store.log.close()
+
+
+def test_budget_enforced_under_sustained_writes(dcfg, tmp_path):
+    """The --resident-rows budget holds on the commit path once an
+    image covers eviction candidates; writes are never refused."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=24)
+    populate(node, 24)
+    node.checkpoint_now()
+    populate(node, 72, start=24)
+    cold = node.store.cold
+    # the 24 image-covered keys were evicted as the budget demanded;
+    # the uncovered remainder waits for the next stamp (soft budget —
+    # pressure requested a checkpoint instead of refusing writes)
+    assert len(cold.cold_set) == 24
+    node.checkpoint_now(full=True)
+    populate(node, 8, start=96)
+    assert cold.resident_rows() <= 24 + 8
+    vals, _ = node.read_objects([(i, "counter_pn", "b")
+                                 for i in range(104)])
+    assert vals == [i + 1 for i in range(104)]
+    node.store.log.close()
+
+
+def test_dirty_rows_are_not_evictable(dcfg, tmp_path):
+    """A row written since the anchor stamp fails the head_vc equality
+    probe and stays resident — eviction can never lose a write."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 8)
+    node.checkpoint_now()
+    node.update_objects([(3, "counter_pn", "b", ("increment", 100))])
+    cold = node.store.cold
+    cold.budget = 1
+    cold.evict_now(max_rows=1024)
+    assert (3, "b") in node.store.directory  # dirty: kept resident
+    assert (5, "b") not in node.store.directory  # clean: evicted
+    vals, _ = node.read_objects([(3, "counter_pn", "b"),
+                                 (5, "counter_pn", "b")])
+    assert vals == [104, 6]
+    node.store.log.close()
+
+
+def test_cold_fault_rate_cap_and_injected_fault_typed(dcfg, tmp_path):
+    """Past the rate cap — or behind an injected coldtier.fault — the
+    read is refused with a typed ColdMiss carrying a retry hint; the
+    key is NEVER served bottom."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 12)
+    node.checkpoint_now()
+    cold = node.store.cold
+    cold.budget = 2
+    cold.evict_now(max_rows=1024)
+    cold.budget = 1 << 30  # stop re-evicting what we fault in
+    cold.fault_rate_cap = 2.0
+    ok, refused = 0, 0
+    for i in range(6):
+        if (i, "b") not in cold.cold_set:
+            continue
+        try:
+            vals, _ = node.read_objects([(i, "counter_pn", "b")])
+            assert vals == [i + 1]  # exact, never bottom
+            ok += 1
+        except ColdMiss as e:
+            assert e.retry_after_ms >= 25
+            refused += 1
+    assert ok == 2 and refused >= 1
+    assert node.metrics.coldtier_events.value(event="refused") >= 1
+    # injected fault site: typed refusal, retriable
+    cold.fault_rate_cap = 0.0
+    victim = next(iter(cold.cold_set))
+    faults.install(faults.FaultPlan(seed=5).io_error("coldtier.fault",
+                                                     times=1))
+    with pytest.raises(ColdMiss):
+        node.read_objects([(victim[0], "counter_pn", "b")])
+    faults.uninstall()
+    vals, _ = node.read_objects([(victim[0], "counter_pn", "b")])
+    assert vals == [victim[0] + 1]
+    node.store.log.close()
+
+
+def test_cold_sidecar_row_crc_catches_bit_rot(dcfg, tmp_path):
+    """A flipped byte in the sidecar row is caught by the per-row CRC at
+    fault-in: typed ColdMiss (and a forced-rebase nudge), never a wrong
+    value."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 8)
+    node.checkpoint_now()
+    cold = node.store.cold
+    cold.budget = 1
+    cold.evict_now(max_rows=1024)
+    cold.budget = 1 << 30
+    victim = sorted(cold.cold_set)[0]
+    ref = cold.refs[victim]
+    # flip one byte of the victim's head field inside cold.bin
+    sc = cold._sidecar(ref.src)
+    tman = sc.man["tables"][ref.tname]
+    f0 = sorted(tman["fields"])[0]
+    spec = tman["fields"][f0]
+    rb = int(np.dtype(spec["dtype"]).itemsize
+             * max(1, int(np.prod(spec["shape"]))))
+    off = spec["off"] + (ref.shard * tman["rows"] + ref.srow) * rb
+    path = ckpt.cold_path(node.store.log.dir, ref.src)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    cold._drop_sidecar_cache()
+    with pytest.raises(ColdMiss, match="verification"):
+        node.read_objects([(victim[0], "counter_pn", "b")])
+    assert node.metrics.coldtier_events.value(event="crc_fail") == 1
+    assert node.checkpointer.force_rebase is True
+    # the forced rebase re-reads every row; the corrupt one is LOST and
+    # tombstoned typed-permanent (surfaced, never silent)
+    node.checkpoint_now()
+    with pytest.raises(ColdMiss, match="peer"):
+        node.read_objects([(victim[0], "counter_pn", "b")])
+    assert victim in cold.lost
+    # every other key still exact
+    others = [i for i in range(8) if (i, "b") != victim]
+    vals, _ = node.read_objects([(i, "counter_pn", "b") for i in others])
+    assert vals == [i + 1 for i in others]
+    node.store.log.close()
+
+
+def test_cold_miss_typed_on_the_wire(dcfg, tmp_path):
+    """The wire server maps ColdMiss to a typed cold_miss error reply
+    with the retry hint (RemoteColdMiss client-side)."""
+    from antidote_tpu.proto.client import AntidoteClient, RemoteColdMiss
+    from antidote_tpu.proto.server import ProtocolServer
+
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 8)
+    node.checkpoint_now()
+    cold = node.store.cold
+    cold.budget = 1
+    cold.evict_now(max_rows=1024)
+    cold.budget = 1 << 30
+    victim = next(iter(cold.cold_set))
+    srv = ProtocolServer(node, port=0)
+    try:
+        c = AntidoteClient(port=srv.port)
+        # persistent rule: the read pipeline's merged→solo retry would
+        # absorb a one-shot fault (and that retry-absorption is GOOD —
+        # a transient fault-in error self-heals invisibly)
+        faults.install(faults.FaultPlan(seed=6).io_error("coldtier.fault"))
+        with pytest.raises(RemoteColdMiss) as ei:
+            c.read_objects([(victim[0], "counter_pn", "b")])
+        assert ei.value.retry_after_ms >= 25
+        faults.uninstall()
+        vals, _ = c.read_objects([(victim[0], "counter_pn", "b")])
+        assert vals == [victim[0] + 1]
+        c.close()
+    finally:
+        srv.close()
+        node.store.log.close()
+
+
+def test_cold_keys_recover_cold_and_fault_on_demand(dcfg, tmp_path):
+    """Recovery of a beyond-RAM image installs only the resident set;
+    cold keys register fault-in refs and read exact on demand."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=1 << 30)
+    populate(node, 40)
+    node.checkpoint_now()
+    cold = node.store.cold
+    cold.budget = 10
+    cold.evict_now(max_rows=1024)
+    n_cold = len(cold.cold_set)
+    assert n_cold >= 24
+    node.checkpoint_now(full=True)  # image carries the cold appendix
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"), recover=True,
+                      resident_rows=1 << 30)
+    assert len(n2.store.cold.cold_set) == n_cold
+    assert len(n2.store.directory) == 40 - n_cold
+    vals, _ = n2.read_objects([(i, "counter_pn", "b") for i in range(40)])
+    assert vals == [i + 1 for i in range(40)]
+    assert n2.store.cold.faults == n_cold
+    n2.store.log.close()
+
+
+# ---------------------------------------------------------------------------
+# Merkle tree units
+# ---------------------------------------------------------------------------
+def test_merkle_root_tracks_flat_digest_oracle(dcfg, tmp_path):
+    """Root equality ⟺ flat shard_digest equality, across genuinely
+    different states and across replicas reaching the same state."""
+    a = AntidoteNode(dcfg, log_dir=str(tmp_path / "a"))
+    b = AntidoteNode(dcfg, log_dir=str(tmp_path / "b"))
+    ops = [(i, "counter_pn", "b", ("increment", i + 1)) for i in range(24)]
+    for node in (a, b):
+        for op in ops:
+            # identical single-op commits mint identical clocks
+            node.update_objects([op])
+    for shard in range(dcfg.n_shards):
+        assert shard_digest(a.store, shard) == shard_digest(b.store, shard)
+        assert get_merkle(a.store).root(shard) == \
+            get_merkle(b.store).root(shard)
+    # diverge ONE key: exactly its shard's digest and root change
+    tname, shard, row = a.store.directory[(7, "b")]
+    t = a.store.tables[tname]
+    f0 = next(iter(t.head))
+    t.head[f0] = t.head[f0].at[shard, row].set(999)
+    a.store.drop_cached_value((7, "b"))
+    mk = get_merkle(a.store)
+    for s in range(dcfg.n_shards):
+        mk.rescan(s)
+        flat_eq = shard_digest(a.store, s) == shard_digest(b.store, s)
+        root_eq = mk.root(s) == get_merkle(b.store).root(s)
+        assert flat_eq == root_eq == (s != shard)
+    a.store.log.close(), b.store.log.close()
+
+
+def test_merkle_single_flip_localizes_to_one_leaf(dcfg, tmp_path):
+    """A single-row flip changes exactly ONE leaf hash, and a top-down
+    walk reaches it in O(fanout·depth) comparisons — the pinned
+    O(log n) probe count."""
+    a = AntidoteNode(dcfg, log_dir=str(tmp_path / "a"))
+    b = AntidoteNode(dcfg, log_dir=str(tmp_path / "b"))
+    for node in (a, b):
+        for i in range(50):
+            node.update_objects([(i, "counter_pn", "b",
+                                  ("increment", 1))])
+    mka, mkb = get_merkle(a.store), get_merkle(b.store)
+    tname, shard, row = a.store.directory[(13, "b")]
+    t = a.store.tables[tname]
+    f0 = next(iter(t.head))
+    t.head[f0] = t.head[f0].at[shard, row].set(999)
+    a.store.drop_cached_value((13, "b"))
+    mka.rescan(shard)
+    la = mka._refresh(shard)
+    lb = mkb._refresh(shard)
+    diff = [i for i, (x, y) in enumerate(zip(la, lb)) if x != y]
+    assert diff == [leaf_of(13, "b", mka.n_leaves)]
+    # walk: follow mismatching children only, count comparisons
+    probes = 0
+    frontier = [(0, 0)]
+    for level in range(mka.depth()):
+        nxt = []
+        for _lv, idx in frontier:
+            ca = mka.children(shard, level, idx)
+            cb = mkb.children(shard, level, idx)
+            probes += len(ca)
+            for child, (x, y) in enumerate(zip(ca, cb)):
+                if x != y:
+                    nxt.append((level + 1, idx * mka.fanout + child))
+        frontier = nxt
+    assert [i for _l, i in frontier] == diff
+    assert probes <= mka.fanout * mka.depth(), probes  # O(log n), not O(n)
+    a.store.log.close(), b.store.log.close()
+
+
+def test_merkle_incremental_marks_match_full_rebuild(dcfg, tmp_path):
+    """Incrementally-maintained leaves equal a from-scratch rebuild
+    after arbitrary writes (the maintenance-correctness pin)."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"))
+    for i in range(30):
+        node.update_objects([(i, "counter_pn", "b", ("increment", 1))])
+    mk = get_merkle(node.store)
+    roots0 = [mk.root(s) for s in range(dcfg.n_shards)]
+    for i in range(0, 30, 3):
+        node.update_objects([(i, "counter_pn", "b", ("increment", 5))])
+    incr = [mk.root(s) for s in range(dcfg.n_shards)]
+    fresh = MerkleIndex(node.store)
+    rebuilt = [fresh.root(s) for s in range(dcfg.n_shards)]
+    assert incr == rebuilt
+    assert incr != roots0
+    node.store.log.close()
+
+
+def test_chain_with_evictions_recovers_without_resident_rows_flag(
+        dcfg, tmp_path):
+    """A chain whose delta links record evictions must recover EXACTLY
+    even when the restart omits --resident-rows: install_delta attaches
+    a cold tier itself rather than dropping the evicted keys' directory
+    entries into silent bottoms."""
+    node = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"),
+                        resident_rows=12)
+    node.start_checkpointer(interval_s=0.0, rebase_every=64)
+    populate(node, 12)
+    node.checkpoint_now(full=True)
+    populate(node, 24, start=12)  # evicts the first 12 (anchored)
+    assert len(node.store.cold.cold_set) == 12
+    s = node.checkpoint_now()  # delta recording the evictions
+    assert s["kind"] == "delta"
+    node.store.log.close()
+    n2 = AntidoteNode(dcfg, log_dir=str(tmp_path / "w"), recover=True)
+    assert n2.store.cold is not None  # attached by the chain compose
+    vals, _ = n2.read_objects([(i, "counter_pn", "b") for i in range(36)])
+    assert vals == [i + 1 for i in range(36)]
+    n2.store.log.close()
+
+
+def test_follower_bootstraps_from_beyond_ram_owner(dcfg, tmp_path):
+    """A follower of a cold-bearing owner ships the cold sidecar with
+    the image, stages it, persists it into its own first local rebase,
+    and serves every key — resident and cold — exactly."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_follower import converge, mk_owner
+
+    from antidote_tpu.interdc import FollowerReplica, LoopbackHub
+
+    hub = LoopbackHub()
+    owner, orep = mk_owner(dcfg, hub, tmp_path)
+    owner.enable_cold_tier(0)
+    populate(owner, 24)
+    owner.checkpoint_now()
+    owner.store.cold.budget = 6
+    owner.store.cold.evict_now(max_rows=1024)
+    owner.store.cold.budget = 0
+    n_cold = len(owner.store.cold.cold_set)
+    assert n_cold >= 16
+    owner.checkpoint_now(full=True)  # the image a follower will fetch
+    fnode = AntidoteNode(dcfg, log_dir=str(tmp_path / "fol"))
+    fol = FollowerReplica(fnode, hub, "fcold",
+                          owner_client_addr=("h", 1), fabric_id=99)
+    mode = fol.attach(orep.descriptor())
+    assert mode == "image"
+    # the follower registered the owner's cold keys against its OWN
+    # locally-persisted sidecar (the staged import was consumed by the
+    # forced local rebase)
+    assert fnode.store.cold is not None
+    assert len(fnode.store.cold.cold_set) == n_cold
+    assert not fnode.store.cold._extra_sources
+    objs = [(i, "counter_pn", "b") for i in range(24)]
+    converge(owner, orep, hub, fnode, objs)
+    got, _ = fnode.read_objects(objs)  # faults the cold ones in locally
+    assert got == [i + 1 for i in range(24)]
+    assert all(v == "ok" for v in fol.check_divergence().values())
+    owner.store.log.close(), fnode.store.log.close()
+
+
+def test_unsubscribed_peer_lane_types_divergence(dcfg, tmp_path):
+    """A follower of a geo-replicated owner that was never given the
+    peer DC's descriptor reports 'unsubscribed' (typed, counted) for
+    lanes only the peer advances — not an eternally-green 'skipped'."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_follower import converge, mk_follower, mk_owner
+
+    from antidote_tpu.interdc import DCReplica, LoopbackHub
+
+    hub = LoopbackHub()
+    owner, orep = mk_owner(dcfg, hub, tmp_path)
+    peer = AntidoteNode(dcfg, dc_id=1, log_dir=str(tmp_path / "peer"))
+    prep = DCReplica(peer, hub, "dc1")
+    orep.observe_dc(prep), prep.observe_dc(orep)
+    owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    owner.checkpoint_now()
+    # follower gets ONLY the owner's descriptor — no --follower-peers
+    fnode, fol, _mode = mk_follower(dcfg, hub, tmp_path, orep)
+    converge(owner, orep, hub, fnode, [("k", "counter_pn", "b")])
+    # the PEER commits: the owner's lane-1 clock advances, the
+    # follower's never can (it holds no dc1 subscription)
+    peer.update_objects([("p", "counter_pn", "b", ("increment", 7))])
+    prep.heartbeat()
+    hub.pump()
+    owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
+    orep.heartbeat()
+    hub.pump()
+    res = fol.check_divergence()
+    assert "unsubscribed" in res.values(), res
+    assert fnode.metrics.divergence_checks.value(
+        result="unsubscribed") >= 1
+    assert fol.divergence_counts.get("unsubscribed", 0) >= 1
+    owner.store.log.close(), peer.store.log.close()
+    fnode.store.log.close()

@@ -124,6 +124,7 @@ def check_file(path: str):
     _check_fsync_policy(path, lines, problems)
     _check_reclaim_policy(path, lines, problems)
     _check_epoch_stamp(path, lines, problems)
+    _check_evict_policy(path, lines, problems)
     return problems
 
 
@@ -339,6 +340,46 @@ def _check_epoch_stamp(path, lines, problems) -> None:
                     "publish_applied_epoch_locked, or justify with "
                     "'# vc-stamped: <reason>'"
                 )
+
+
+#: the one module allowed to drop device table rows freely: the cold
+#: tier owns the evict lifecycle (ISSUE 13) and its calls run behind the
+#: verified-coverage checks (live head_vc byte-equal to the anchor
+#: sidecar's stamp).  A ``.evict_rows(`` call anywhere else is either a
+#: data-loss bug waiting to happen (a device row dropped with no sidecar
+#: covering it) or a deliberate compose/heal step that must say so with
+#: an ``# evict-ok: <reason>`` note.
+_EVICT_OWNER = os.path.join("antidote_tpu", "store", "coldtier.py")
+_EVICT_DEF = os.path.join("antidote_tpu", "store", "typed_table.py")
+
+
+def _check_evict_policy(path, lines, problems) -> None:
+    """Reject ``.evict_rows(`` outside store/coldtier.py (and its
+    defining module) without an ``# evict-ok: <reason>`` annotation on
+    the line or within the three preceding lines — cold-tier
+    device-buffer drops go through the guarded evict API with written
+    justification."""
+    norm = os.path.normpath(path)
+    if norm.endswith(_EVICT_OWNER) or norm.endswith(_EVICT_DEF) \
+            or os.sep + "tests" + os.sep in norm \
+            or norm.startswith("tests" + os.sep) \
+            or os.path.basename(norm) == "lint.py":  # the rule's source
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("evict-ok:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        if ".evict_rows(" in code and not annotated(i) \
+                and "evict-ok:" not in ln:
+            problems.append(
+                f"{path}:{i}: device-row drop '.evict_rows(' outside "
+                "the cold tier — route it through store/coldtier.py's "
+                "guarded evict (verified sidecar coverage), or justify "
+                "with '# evict-ok: <reason>'"
+            )
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
