@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke hotkey-smoke lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -92,6 +92,17 @@ mesh-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PY) -m pytest tests/test_mesh.py -q
 	$(PY) tools/bench_mesh.py --smoke --assert-bounds
+
+# celebrity-key materializer (ISSUE 15): the fold-strategy parity suite
+# plus a short one-key over-ring run timing every strategy the store can
+# route it to (serial scan / assoc delta / chunked long / mesh-sharded /
+# Pallas ring kernel) with concurrent snapshot readers.  The gate is
+# STRUCTURAL only (byte parity, every strategy ran, readers progressed);
+# the frozen BENCH_HOTKEY_cpu.json speedups (assoc + mesh_assoc >= 4x
+# serial on the full 1M-op freeze) are never a CI ratchet
+hotkey-smoke:
+	$(PY) -m pytest tests/test_fold_parity.py -q
+	$(PY) tools/bench_hotkey.py --smoke --assert-bounds
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

@@ -66,6 +66,13 @@ class AntidoteConfig:
     #: kernel exists (counter fold, OR-set presence, stable-VC min); the
     #: generic XLA scan fold remains the fallback and the semantics oracle
     use_pallas: bool = False
+    #: over-ring fold routing threshold (store/kv.py::_replay_read_many):
+    #: a replayed key whose op-log extent exceeds this folds with the
+    #: chunked ``fold_long`` (or, assoc types on a mesh, the op-axis-
+    #: sharded ``sharded_assoc_fold``) instead of one giant serial scan —
+    #: and each strategy's pad-to-multiple keeps XLA compile families
+    #: bounded instead of one fresh compile per log length
+    fold_chunk: int = 4096
 
     # --- misc ----------------------------------------------------------
     #: store a fresh snapshot version only if at least this many ops were

@@ -131,7 +131,9 @@ def cmd_serve(args) -> int:
     cfg = AntidoteConfig(n_shards=shards, max_dcs=max_dcs,
                          keys_per_table=args.keys_per_table,
                          wal_segments=args.wal_segments,
-                         sync_log=args.sync_log)
+                         sync_log=args.sync_log,
+                         use_pallas=args.pallas,
+                         fold_chunk=args.fold_chunk)
     from antidote_tpu.log.checkpoint import has_checkpoints
 
     has_wal_data = args.log_dir is not None and os.path.isdir(args.log_dir) and (
@@ -674,6 +676,18 @@ def main(argv=None) -> int:
                          "sync_log=false — an ack then means 'reached "
                          "the OS', durable within the WAL's background "
                          "sync interval")
+    sv.add_argument("--pallas", action="store_true",
+                    help="dispatch the materializer hot loops to the "
+                         "fused Pallas kernels where one exists (counter "
+                         "fold, set_aw add-wins fold, OR-set presence); "
+                         "interpret mode off-TPU — the XLA scan stays "
+                         "the fallback and semantics oracle")
+    sv.add_argument("--fold-chunk", type=int, default=4096,
+                    help="over-ring fold routing threshold: a replayed "
+                         "key whose op log exceeds this many ops folds "
+                         "with the chunked/sequence-sharded strategies "
+                         "instead of one serial scan (docs/performance."
+                         "md, 'Sequence-axis parallel folds')")
     sv.add_argument("--checkpoint-interval-s", type=float, default=300.0,
                     help="background checkpoint cadence (ISSUE 8): each "
                          "cycle publishes a VC-stamped store image and "

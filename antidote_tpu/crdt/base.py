@@ -44,6 +44,15 @@ class CRDTType(abc.ABC):
     #: logs reduce in O(log L) depth and partial folds merge across
     #: devices (materializer/longlog.py; SURVEY §2.10 last row)
     supports_assoc: bool = False
+    #: assoc fold is exact only from a BOTTOM base state: the delta window
+    #: replays slot claims in sequence order, which matches ``apply`` only
+    #: when every slot starts empty (sets).  Ring fold sites serve from an
+    #: arbitrary GC'd base and must not route these through assoc_fold;
+    #: replay/GC paths that build from bottom may.
+    assoc_bottom_only: bool = False
+    #: assoc fold additionally requires an all-adds window (set_aw: an
+    #: observed-remove is order-sensitive against the adds around it)
+    assoc_add_only: bool = False
     #: True for op-based types whose BLIND effects commute (counters,
     #: sets, flags): an update with no state-dependent downstream from a
     #: txn that read nothing needs no first-committer-wins round at all

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,6 +41,49 @@ def _elem_effects(op, blobs, make):
         return [make(v) for v in arg]
     return [make(arg)]
 
+
+
+def _dedup_window(w, hs, counts, rows=None):
+    """Compress a (possibly duplicated) handle sequence into a W-entry
+    delta window: first-occurrence-ordered distinct handles, per-handle
+    summed ``counts``, optional per-handle lane-maxed clock ``rows``, and
+    the op count that overflowed the window (``tail``) — the set types'
+    associative-delta core.
+
+    W static passes, each claiming the sequence-FIRST unclaimed handle
+    (argmax over a shrinking bool mask finds the first True) and tagging
+    every occurrence with its window slot: O(W·L) work at O(W) depth.
+    With W = set_slots (≤ tens) this beats the sort-based dedup by an
+    order of magnitude on million-op celebrity logs — a stable i64
+    argsort alone costs more than the whole serial scan budget.  Ops
+    whose handle never wins a slot keep the ``w`` sentinel and fall into
+    ``tail``.
+    """
+    valid = hs != EMPTY_HANDLE
+    l = hs.shape[0]
+    entry = jnp.full((l,), w, jnp.int32)
+    remaining = valid
+    elems_slots = []
+    for slot in range(w):
+        idx = jnp.argmax(remaining)  # first unclaimed position (or 0)
+        h = jnp.where(remaining[idx], hs[idx], EMPTY_HANDLE)
+        # remaining ⊆ valid and valid excludes EMPTY, so an exhausted
+        # mask (h == EMPTY) matches nothing and the slot stays empty
+        match = remaining & (hs == h)
+        entry = jnp.where(match, jnp.int32(slot), entry)
+        remaining = remaining & ~match
+        elems_slots.append(h)
+    elems = jnp.stack(elems_slots)
+    ent_idx = jnp.where(valid, entry, jnp.int32(w))
+    cnt = jnp.zeros((w,), jnp.int32).at[ent_idx].add(counts, mode="drop")
+    tail = jnp.sum(jnp.where(valid & (entry >= w), counts, 0),
+                   dtype=jnp.int32)
+    if rows is None:
+        return elems, cnt, tail
+    vcs = jnp.zeros((w, rows.shape[-1]), jnp.int32).at[ent_idx].max(
+        rows, mode="drop"
+    )
+    return elems, cnt, tail, vcs
 
 
 def _restamp_obs_row(eff_a, eff_b, my_dc, tentative_own, commit_own):
@@ -62,9 +106,77 @@ class SetAW(TopCountResolved, CRDTType):
     name = "set_aw"
     commutative_blind = True
     type_id = 6
+    # the ADD lane is a monoid: from a bottom base, an all-adds window
+    # reduces to (first-occurrence handles, per-handle dot maxes) and
+    # partial windows merge associatively.  Removes and warm bases are
+    # order-sensitive (slot steals), so dispatchers gate on both flags.
+    supports_assoc = True
+    assoc_bottom_only = True
+    assoc_add_only = True
 
     def eff_b_width(self, cfg):
         return 1 + cfg.max_dcs
+
+    # -- associative add-lane fold (materializer/longlog.py) ------------
+    # Exactness preconditions (checked by dispatchers, see
+    # store/kv.py::_replay_read_many): bottom base state, no removes in
+    # the window, distinct handles ≤ set_slots (the slot-promotion
+    # invariant keeps live keys under capacity), and positive own commit
+    # dots (always true for committed ops).
+    def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
+        w, d = cfg.set_slots, cfg.max_dcs
+        ok = mask & (ops_b[:, 0] == 0)  # defensive: adds only
+        hs = jnp.where(ok, ops_a[:, 0], jnp.int64(EMPTY_HANDLE))
+        own = jnp.take_along_axis(ops_vc, ops_origin[:, None], axis=1)[:, 0]
+        rows = jax.nn.one_hot(ops_origin, d, dtype=jnp.int32) * jnp.where(
+            ok, own, 0
+        )[:, None].astype(jnp.int32)
+        counts = ok.astype(jnp.int32)
+        elems, cnt, tail, addvc = _dedup_window(w, hs, counts, rows)
+        return {"elems": elems, "counts": cnt, "addvc": addvc, "tail": tail}
+
+    def delta_merge(self, a, b):
+        w = a["elems"].shape[0]
+        hs = jnp.concatenate([a["elems"], b["elems"]])
+        counts = jnp.concatenate([a["counts"], b["counts"]])
+        rows = jnp.concatenate([a["addvc"], b["addvc"]])
+        elems, cnt, tail, addvc = _dedup_window(w, hs, counts, rows)
+        return {"elems": elems, "counts": cnt, "addvc": addvc,
+                "tail": a["tail"] + b["tail"] + tail}
+
+    def delta_apply(self, state, d):
+        nd = state["addvc"].shape[-1]
+
+        def body(j, carry):
+            elems, addvc, rmvc, ovf = carry
+            h, cnt, row = d["elems"][j], d["counts"][j], d["addvc"][j]
+            valid = h != EMPTY_HANDLE
+            match = (elems == h) & (elems != EMPTY_HANDLE)
+            has_match = jnp.any(match)
+            present = jnp.any(addvc > rmvc, axis=-1) & (elems != EMPTY_HANDLE)
+            free = ~present
+            idx = jnp.where(has_match, jnp.argmax(match), jnp.argmax(free))
+            base_add = jnp.where(
+                has_match, addvc[idx], jnp.zeros((nd,), jnp.int32)
+            )
+            base_rm = jnp.where(
+                has_match, rmvc[idx], jnp.zeros((nd,), jnp.int32)
+            )
+            can = valid & (has_match | jnp.any(free))
+            elems = jnp.where(can, elems.at[idx].set(h), elems)
+            addvc = jnp.where(
+                can, addvc.at[idx].set(jnp.maximum(base_add, row)), addvc
+            )
+            rmvc = jnp.where(can, rmvc.at[idx].set(base_rm), rmvc)
+            ovf = ovf + jnp.where(valid & ~can, cnt, 0)
+            return (elems, addvc, rmvc, ovf)
+
+        elems, addvc, rmvc, ovf = jax.lax.fori_loop(
+            0, d["elems"].shape[0], body,
+            (state["elems"], state["addvc"], state["rmvc"],
+             state["ovf"] + d["tail"]),
+        )
+        return {"elems": elems, "addvc": addvc, "rmvc": rmvc, "ovf": ovf}
 
     def state_spec(self, cfg):
         e, d = cfg.set_slots, cfg.max_dcs
@@ -124,11 +236,16 @@ class SetAW(TopCountResolved, CRDTType):
         presence comparison runs as the fused Pallas kernel
         (materializer/pallas_kernels.py::orset_presence) — the in-path
         dispatch VERDICT asked for; the plain-XLA comparison is the
-        fallback."""
+        fallback.  Platform-gated (pallas_kernels.in_path_ok): on CPU the
+        interpreter-mode kernel halved every serving read and the device
+        kernel loop (measured on the 1M bench child)."""
         elems = state["elems"]
+        use_kernel = False
         if getattr(cfg, "use_pallas", False):
             from antidote_tpu.materializer import pallas_kernels as pk
 
+            use_kernel = pk.in_path_ok()
+        if use_kernel:
             lead = elems.shape[:-1]
             e = elems.shape[-1]
             # occupancy in i32 lanes: fold the high word in so a handle
@@ -334,10 +451,52 @@ class SetGO(TopCountResolved, CRDTType):
     name = "set_go"
     commutative_blind = True
     type_id = 8
+    # grow-only inserts from a bottom base are first-occurrence order —
+    # the same delta-window monoid as set_aw's add lane, minus clocks
+    supports_assoc = True
+    assoc_bottom_only = True
 
     def state_spec(self, cfg):
         e = cfg.set_slots
         return {"elems": ((e,), jnp.int64), "ovf": ((), jnp.int32)}
+
+    # -- associative fold (materializer/longlog.py); exact from a bottom
+    # base with distinct handles ≤ set_slots (see SetAW.delta_of_ops) ----
+    def delta_of_ops(self, cfg, ops_a, ops_b, ops_vc, ops_origin, mask):
+        w = cfg.set_slots
+        hs = jnp.where(mask, ops_a[:, 0], jnp.int64(EMPTY_HANDLE))
+        elems, cnt, tail = _dedup_window(w, hs, mask.astype(jnp.int32))
+        return {"elems": elems, "counts": cnt, "tail": tail}
+
+    def delta_merge(self, a, b):
+        w = a["elems"].shape[0]
+        elems, cnt, tail = _dedup_window(
+            w,
+            jnp.concatenate([a["elems"], b["elems"]]),
+            jnp.concatenate([a["counts"], b["counts"]]),
+        )
+        return {"elems": elems, "counts": cnt,
+                "tail": a["tail"] + b["tail"] + tail}
+
+    def delta_apply(self, state, d):
+        def body(j, carry):
+            elems, ovf = carry
+            h, cnt = d["elems"][j], d["counts"][j]
+            valid = h != EMPTY_HANDLE
+            has_match = jnp.any(elems == h)
+            free = elems == EMPTY_HANDLE
+            do_insert = valid & ~has_match & jnp.any(free)
+            elems = jnp.where(
+                do_insert, elems.at[jnp.argmax(free)].set(h), elems
+            )
+            ovf = ovf + jnp.where(valid & ~has_match & ~jnp.any(free), cnt, 0)
+            return (elems, ovf)
+
+        elems, ovf = jax.lax.fori_loop(
+            0, d["elems"].shape[0], body,
+            (state["elems"], state["ovf"] + d["tail"]),
+        )
+        return {"elems": elems, "ovf": ovf}
 
     def is_operation(self, op):
         return op[0] in ("add", "add_all")

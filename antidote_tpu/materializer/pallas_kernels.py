@@ -54,6 +54,20 @@ def _on_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+def in_path_ok() -> bool:
+    """Whether `use_pallas` callers should route the LIVE serving path
+    through these kernels.  On CPU they only run under the Pallas
+    interpreter, and interpret-mode dispatch is a regression, not an
+    upgrade (measured on the 1M bench child: ~2x serve, ~16x mixed
+    load).  ANTIDOTE_PALLAS_INTERPRET=1 is the parity-test escape that
+    forces the interpret kernels in-path anyway."""
+    import os
+
+    if os.environ.get("ANTIDOTE_PALLAS_INTERPRET") == "1":
+        return True
+    return _on_tpu()
+
+
 def _pad_to(x, mult, axis, fill=0):
     n = x.shape[axis]
     rem = (-n) % mult
@@ -247,6 +261,263 @@ def stable_min(clocks, block: int = 512, interpret: bool | None = None):
         return jnp.full((clocks.shape[1],), _I32_MAX, jnp.int32)
     with _x64_off():  # i32 trace default (see counter_fold)
         return _stable_min_call(clocks, block, interpret)
+
+
+# ---------------------------------------------------------------------------
+# OR-set fold: the full add-wins apply rule over the op ring, one pass
+# ---------------------------------------------------------------------------
+def _split_handles(h):
+    """i64 handles -> (lo, hi) i32 bit planes (Mosaic kernels are i32-only;
+    equality tests compare both planes)."""
+    lo = (h & 0xFFFFFFFF).astype(jnp.int32)
+    hi = (h >> 32).astype(jnp.int32)
+    return lo, hi
+
+
+def _join_handles(lo, hi):
+    return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & 0xFFFFFFFF)
+
+
+def _set_aw_fold_kernel(elems_lo_ref, elems_hi_ref, addvc_ref, rmvc_ref,
+                        ovf_ref, h_lo_ref, h_hi_ref, is_rm_ref, obs_ref,
+                        ops_vc_ref, origin_ref, own_ref, n_ops_ref,
+                        base_vc_ref, read_vc_ref,
+                        out_lo_ref, out_hi_ref, out_add_ref, out_rm_ref,
+                        out_ovf_ref, out_applied_ref):
+    # block shapes: elems planes [BLK, E]; addvc/rmvc [D, BLK, E]
+    # (lane-transposed — per-DC comparisons are clean 2D tiles, see
+    # _counter_fold_kernel); ovf/n_ops [BLK, 1]; handle planes / is_rm /
+    # origin / own [BLK, K]; obs/ops_vc [D, BLK, K]; base/read [BLK, D].
+    # The K ring slots unroll as a static loop: each op's add-wins rule
+    # (match / free-slot steal / observed-remove raise) is a masked
+    # comparison over the [BLK, E] element tiles, so the whole ring folds
+    # in one kernel with no [B, K, E] intermediates in HBM.
+    d = ops_vc_ref.shape[0]
+    k = h_lo_ref.shape[1]
+    e = elems_lo_ref.shape[1]
+    v0 = ops_vc_ref[0]                                  # [BLK, K]
+    in_base = v0 <= base_vc_ref[:, 0:1]
+    visible = v0 <= read_vc_ref[:, 0:1]
+    for dd in range(1, d):
+        vd = ops_vc_ref[dd]
+        in_base = in_base & (vd <= base_vc_ref[:, dd:dd + 1])
+        visible = visible & (vd <= read_vc_ref[:, dd:dd + 1])
+    slots = jax.lax.broadcasted_iota(jnp.int32, v0.shape, 1)
+    include_all = (~in_base) & visible & (slots < n_ops_ref[:])  # [BLK, K]
+
+    elems_lo = elems_lo_ref[:]
+    elems_hi = elems_hi_ref[:]
+    add_p = [addvc_ref[dd] for dd in range(d)]          # each [BLK, E]
+    rm_p = [rmvc_ref[dd] for dd in range(d)]
+    ovf = ovf_ref[:]
+    applied = jnp.zeros_like(ovf)
+    iota_e = jax.lax.broadcasted_iota(jnp.int32, elems_lo.shape, 1)
+    zero = jnp.int32(0)
+    for kk in range(k):
+        inc = include_all[:, kk:kk + 1]                 # [BLK, 1]
+        h_lo = h_lo_ref[:, kk:kk + 1]
+        h_hi = h_hi_ref[:, kk:kk + 1]
+        is_rm = is_rm_ref[:, kk:kk + 1] == 1
+        origin = origin_ref[:, kk:kk + 1]
+        own = own_ref[:, kk:kk + 1]
+        occ = (elems_lo | elems_hi) != 0
+        match = (elems_lo == h_lo) & (elems_hi == h_hi) & occ    # [BLK, E]
+        # bool minor-dim reductions don't lower — pin to i32 sums/mins
+        has_match = jnp.sum(
+            match.astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32
+        ) > 0
+        idx_match = jnp.min(
+            jnp.where(match, iota_e, jnp.int32(e)), axis=1, keepdims=True
+        )
+        present = add_p[0] > rm_p[0]
+        for dd in range(1, d):
+            present = present | (add_p[dd] > rm_p[dd])
+        free = ~(present & occ)
+        has_free = jnp.sum(
+            free.astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32
+        ) > 0
+        idx_free = jnp.min(
+            jnp.where(free, iota_e, jnp.int32(e)), axis=1, keepdims=True
+        )
+        idx_add = jnp.where(has_match, idx_match, idx_free)
+        sel_add = iota_e == idx_add
+        sel_match = iota_e == idx_match
+        fresh = ~has_match
+        can_add = has_match | has_free
+        upd_add = inc & (~is_rm) & can_add & sel_add    # [BLK, E]
+        upd_rm = inc & is_rm & has_match & sel_match
+        elems_lo = jnp.where(upd_add, h_lo, elems_lo)
+        elems_hi = jnp.where(upd_add, h_hi, elems_hi)
+        for dd in range(d):
+            # per-row gathers as masked sums (one-hot row select —
+            # dynamic per-row gathers don't tile)
+            row_add = jnp.sum(
+                jnp.where(sel_add, add_p[dd], zero), axis=1, keepdims=True,
+                dtype=jnp.int32,
+            )
+            row_rm = jnp.sum(
+                jnp.where(sel_add, rm_p[dd], zero), axis=1, keepdims=True,
+                dtype=jnp.int32,
+            )
+            a_row = jnp.where(fresh, zero, row_add)
+            r_row = jnp.where(fresh, zero, row_rm)
+            a_row = jnp.where(origin == dd, jnp.maximum(a_row, own), a_row)
+            m_row = jnp.sum(
+                jnp.where(sel_match, rm_p[dd], zero), axis=1, keepdims=True,
+                dtype=jnp.int32,
+            )
+            rm_row = jnp.maximum(m_row, obs_ref[dd][:, kk:kk + 1])
+            add_p[dd] = jnp.where(upd_add, a_row, add_p[dd])
+            rm_p[dd] = jnp.where(
+                upd_add, r_row, jnp.where(upd_rm, rm_row, rm_p[dd])
+            )
+        dropped = inc & (~is_rm) & (~can_add)
+        ovf = ovf + dropped.astype(jnp.int32)
+        applied = applied + inc.astype(jnp.int32)
+    out_lo_ref[:] = elems_lo
+    out_hi_ref[:] = elems_hi
+    for dd in range(d):
+        out_add_ref[dd] = add_p[dd]
+        out_rm_ref[dd] = rm_p[dd]
+    out_ovf_ref[:] = ovf
+    out_applied_ref[:] = applied
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _set_aw_fold_call(elems_lo, elems_hi, addvc, rmvc, ovf,
+                      h_lo, h_hi, is_rm, obs, ops_vc, ops_origin,
+                      n_ops, base_vc, read_vc, block: int, interpret: bool):
+    b0 = elems_lo.shape[0]
+    elems_lo = _pad_to(elems_lo, block, 0)
+    elems_hi = _pad_to(elems_hi, block, 0)
+    addvc = _pad_to(addvc, block, 0)
+    rmvc = _pad_to(rmvc, block, 0)
+    ovf = _pad_to(ovf.reshape(-1, 1), block, 0)
+    h_lo = _pad_to(h_lo, block, 0)
+    h_hi = _pad_to(h_hi, block, 0)
+    is_rm = _pad_to(is_rm, block, 0)
+    obs = _pad_to(obs, block, 0)
+    ops_vc = _pad_to(ops_vc, block, 0)
+    ops_origin = _pad_to(ops_origin, block, 0)
+    n_ops = _pad_to(n_ops.reshape(-1, 1), block, 0)
+    base_vc = _pad_to(base_vc, block, 0)
+    read_vc = _pad_to(read_vc, block, 0, fill=-1)   # nothing visible in pad
+    b, e = elems_lo.shape
+    k = h_lo.shape[1]
+    d = ops_vc.shape[-1]
+    # commit stamp at the origin lane — apply's .at[origin].max(commit_vc
+    # [origin]); gathered here so the kernel never indexes by a dynamic lane
+    own = jnp.take_along_axis(
+        ops_vc, ops_origin[..., None].astype(jnp.int32), axis=2
+    )[..., 0]
+    addvc_t = jnp.transpose(addvc, (2, 0, 1))       # [D, B, E]
+    rmvc_t = jnp.transpose(rmvc, (2, 0, 1))
+    obs_t = jnp.transpose(obs, (2, 0, 1))           # [D, B, K]
+    ops_vc_t = jnp.transpose(ops_vc, (2, 0, 1))
+    grid = (b // block,)
+    row = lambda w: pl.BlockSpec((block, w), lambda i: (i, 0))
+    plane = lambda w: pl.BlockSpec((d, block, w), lambda i: (0, i, 0))
+    lo, hi, addp, rmp, ovf2, applied = pl.pallas_call(
+        _set_aw_fold_kernel,
+        grid=grid,
+        in_specs=[
+            row(e), row(e), plane(e), plane(e), row(1),
+            row(k), row(k), row(k), plane(k), plane(k), row(k), row(k),
+            row(1), row(d), row(d),
+        ],
+        out_specs=[
+            row(e), row(e), plane(e), plane(e), row(1), row(1),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, e), jnp.int32),
+            jax.ShapeDtypeStruct((b, e), jnp.int32),
+            jax.ShapeDtypeStruct((d, b, e), jnp.int32),
+            jax.ShapeDtypeStruct((d, b, e), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(elems_lo, elems_hi, addvc_t, rmvc_t, ovf,
+      h_lo, h_hi, is_rm, obs_t, ops_vc_t, ops_origin, own,
+      n_ops, base_vc, read_vc)
+    return (
+        lo[:b0], hi[:b0],
+        jnp.transpose(addp, (1, 2, 0))[:b0],
+        jnp.transpose(rmp, (1, 2, 0))[:b0],
+        ovf2[:b0, 0], applied[:b0, 0],
+    )
+
+
+def _set_aw_fold_planes(state, ops_a, ops_b, ops_vc, ops_origin,
+                        n_ops, base_vc, read_vc, block, interpret):
+    """Shared i32-plane marshalling for both set_aw fold entries.  Handle
+    splitting happens HERE, where i64 is available — the jitted call takes
+    only i32 operands so it traces identically with x64 on or off."""
+    d = ops_vc.shape[-1]
+    elems_lo, elems_hi = _split_handles(jnp.asarray(state["elems"], jnp.int64))
+    h_lo, h_hi = _split_handles(jnp.asarray(ops_a, jnp.int64)[..., 0])
+    ops_b = jnp.asarray(ops_b, jnp.int32)
+    lo, hi, addvc, rmvc, ovf, applied = _set_aw_fold_call(
+        elems_lo, elems_hi,
+        jnp.asarray(state["addvc"], jnp.int32),
+        jnp.asarray(state["rmvc"], jnp.int32),
+        jnp.asarray(state["ovf"], jnp.int32),
+        h_lo, h_hi, ops_b[..., 0], ops_b[..., 1:1 + d],
+        jnp.asarray(ops_vc, jnp.int32), jnp.asarray(ops_origin, jnp.int32),
+        jnp.asarray(n_ops, jnp.int32), jnp.asarray(base_vc, jnp.int32),
+        jnp.asarray(read_vc, jnp.int32), block, interpret,
+    )
+    return lo, hi, addvc, rmvc, ovf, applied
+
+
+def set_aw_fold(state, ops_a, ops_b, ops_vc, ops_origin, n_ops,
+                base_vc, read_vc, block: int = 256,
+                interpret: bool | None = None):
+    """Batched set_aw materialization as one fused Pallas pass — the
+    BASELINE workload's own fold on a kernel.
+
+    ``state`` = {elems i64[B, E], addvc/rmvc i32[B, E, D], ovf i32[B]},
+    ``ops_a`` i64[B, K, A] (lane 0 = element handle), ``ops_b``
+    i32[B, K, 1+D] (kind + observed add VC), ``ops_vc`` i32[B, K, D],
+    ``ops_origin`` i32[B, K], ``n_ops`` i32[B], ``base_vc``/``read_vc``
+    i32[B, D].  Returns (state, applied i32[B]) — byte-identical to
+    ``fold.fold_batch`` for set_aw (the add-wins observed-remove rule,
+    including slot-steal ordering and the ovf drop counter).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    # no _x64_off() here: the i64 handle split REQUIRES x64, and the jitted
+    # call then sees only dtype-pinned i32 operands so the trace is
+    # identical either way
+    lo, hi, addvc, rmvc, ovf, applied = _set_aw_fold_planes(
+        state, ops_a, ops_b, jnp.asarray(ops_vc, jnp.int32), ops_origin,
+        n_ops, base_vc, read_vc, block, interpret,
+    )
+    return {
+        "elems": _join_handles(lo, hi),
+        "addvc": addvc, "rmvc": rmvc, "ovf": ovf,
+    }, applied
+
+
+def set_aw_fold_local(state, ops_a, ops_b, ops_vc, ops_origin, n_ops,
+                      base_vc, read_vc, block: int = 256,
+                      interpret: bool | None = None):
+    """Shard-LOCAL / trace-safe set_aw fold — the kernel entry for the
+    fused serving reads and sharded-step bodies: operands are one block's
+    rows (same shapes as :func:`set_aw_fold` with B = the block's row
+    count), no x64 toggling and no host-side work, so it is callable from
+    inside an outer jit/shard_map trace.  The kernel grid never crosses
+    the shard axis.  Returns (state pytree, applied i32[B])."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    lo, hi, addvc, rmvc, ovf, applied = _set_aw_fold_planes(
+        state, ops_a, ops_b, jnp.asarray(ops_vc, jnp.int32), ops_origin,
+        n_ops, base_vc, read_vc, block, interpret,
+    )
+    return {
+        "elems": _join_handles(lo, hi),
+        "addvc": addvc, "rmvc": rmvc, "ovf": ovf,
+    }, applied
 
 
 # ---------------------------------------------------------------------------

@@ -131,16 +131,28 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 1000
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+    """Fixed-bucket histogram, optionally labeled.
+
+    With ``label_names`` set, each observed label tuple gets its own
+    (buckets, sum, count) child series in the exposition, while the
+    unlabeled aggregate keeps feeding :meth:`summary` / :meth:`percentile`
+    so node-status blocks stay label-agnostic.
+    """
+
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS,
+                 label_names: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_
         self.buckets = tuple(sorted(buckets))
+        self.label_names = tuple(label_names)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
+        #: label tuple -> [bucket counts, sum, count]
+        self._children: Dict[Tuple[str, ...], list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, **labels) -> None:
         with self._lock:
             i = 0
             while i < len(self.buckets) and v > self.buckets[i]:
@@ -148,6 +160,15 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._n += 1
+            if self.label_names:
+                key = tuple(str(labels.get(n, "")) for n in self.label_names)
+                child = self._children.get(key)
+                if child is None:
+                    child = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                    self._children[key] = child
+                child[0][i] += 1
+                child[1] += v
+                child[2] += 1
 
     @property
     def count(self) -> int:
@@ -181,6 +202,28 @@ class Histogram:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:  # consistent (buckets, sum, count) snapshot
             counts, total, n = list(self._counts), self._sum, self._n
+            children = {
+                k: (list(c[0]), c[1], c[2]) for k, c in self._children.items()
+            }
+        if self.label_names:
+            for key in sorted(children):
+                labels = dict(zip(self.label_names, key))
+                ccounts, csum, cn = children[key]
+                acc = 0
+                for i, b in enumerate(self.buckets):
+                    acc += ccounts[i]
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels({**labels, 'le': str(b)})} {acc}"
+                    )
+                acc += ccounts[-1]
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**labels, 'le': '+Inf'})} {acc}"
+                )
+                out.append(f"{self.name}_sum{_fmt_labels(labels)} {csum:g}")
+                out.append(f"{self.name}_count{_fmt_labels(labels)} {cn}")
+            return out
         acc = 0
         for i, b in enumerate(self.buckets):
             acc += counts[i]
@@ -210,8 +253,8 @@ class MetricsRegistry:
     def gauge(self, name, help_="", label_names=()):
         return self.register(Gauge(name, help_, tuple(label_names)))
 
-    def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS):
-        return self.register(Histogram(name, help_, buckets))
+    def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS, label_names=()):
+        return self.register(Histogram(name, help_, buckets, tuple(label_names)))
 
     def get(self, name):
         return self._metrics[name]
@@ -470,6 +513,21 @@ class NodeMetrics:
             "readback (s); launched only when a commit advanced an "
             "applied clock (cached otherwise)",
             buckets=stage_buckets,
+        )
+        # materializer fold plane (ISSUE 15): which fold strategy served
+        # each read / replay, and how long the over-ring replay folds take
+        self.fold_dispatch = r.counter(
+            "antidote_fold_dispatch_total",
+            "Materializer fold dispatches by strategy (serial | assoc | "
+            "long | mesh_assoc | pallas_counter | pallas_set_aw)",
+            ("strategy",),
+        )
+        self.fold_seconds = r.histogram(
+            "antidote_fold_seconds",
+            "Over-ring replay fold latency, dispatch to host "
+            "materialize (s)",
+            buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+            label_names=("strategy", "type"),
         )
         # write plane (ISSUE 6): cross-connection group commit, parallel
         # WAL group fsync, and the commutative-update cert bypass

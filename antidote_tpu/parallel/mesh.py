@@ -54,6 +54,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from antidote_tpu.compat import shard_map
+from antidote_tpu.materializer import longlog
 from antidote_tpu.parallel.spmd import SHARD_AXIS
 from antidote_tpu.store.typed_table import _shard_read_latest_body
 
@@ -98,6 +99,11 @@ class MeshServingPlane:
         self._stable_lock = threading.Lock()
         #: pmin collectives actually launched (cache misses)
         self.stable_collectives = 0
+        #: compiled sequence-parallel giant-key folds, keyed by
+        #: (type name, cfg) — cfg is a frozen (hashable) dataclass
+        self._giant_fold_fns: dict = {}
+        #: giant-key folds dispatched through the mesh (node status)
+        self.giant_folds = 0
 
     # ------------------------------------------------------------------
     # placement
@@ -177,6 +183,58 @@ class MeshServingPlane:
         ))
 
     # ------------------------------------------------------------------
+    # giant-key sequence sharding (ROADMAP item 6 / SURVEY §5)
+    # ------------------------------------------------------------------
+    def fold_giant_key(self, ty, cfg, state0, ops_a, ops_b, ops_vc,
+                       ops_origin, n_ops, base_vc, read_vc):
+        """Fold ONE key's over-ring op log with the op axis sharded over
+        the device mesh: every device reduces its contiguous chunk of the
+        sequence to a partial delta, one ``all_gather`` exchanges the
+        (tiny) deltas, and the monoid tree merges them in sequence order
+        — ring attention's partial-softmax exchange, rendered for the
+        celebrity-key materialization (``longlog.sharded_assoc_fold_fn``).
+
+        Host-assembled operands on the leading op axis L (e.g. from WAL
+        replay): ops_a i64[L, A], ops_b i32[L, B], ops_vc i32[L, D],
+        ops_origin i32[L]; ``n_ops`` = the true op count ≤ L; base_vc /
+        read_vc i32[D].  Requires ``ty.supports_assoc``.  L is padded to
+        a power-of-two device multiple here — padded slots sit at global
+        index ≥ n_ops, so the inclusion mask drops them; the bucketing
+        keeps one XLA compile family per doubling, not per log length.
+
+        Returns (state pytree, applied) as DEVICE arrays — callers own
+        the materialize (no sync here).
+        """
+        fn = self._giant_fold_fns.get((ty.name, cfg))
+        if fn is None:
+            fn = longlog.sharded_assoc_fold_fn(ty, cfg, self.mesh)
+            self._giant_fold_fns[(ty.name, cfg)] = fn
+        l = int(ops_vc.shape[0])
+        padded = self.n_devices
+        while padded < l:
+            padded *= 2
+        pad = padded - l
+
+        def padl(x, dtype):
+            x = np.asarray(x, dtype)  # sync-ok: host-assembled replay log
+            if pad:
+                x = np.concatenate(
+                    [x, np.zeros((pad,) + x.shape[1:], dtype)]
+                )
+            return x
+
+        self.giant_folds += 1
+        return fn(
+            state0,
+            padl(ops_a, np.int64), padl(ops_b, np.int32),
+            padl(ops_vc, np.int32), padl(ops_origin, np.int32),
+            # sync-ok: host scalars/clocks from the replay cut, not
+            # device arrays
+            np.int32(n_ops), np.asarray(base_vc, np.int32),
+            np.asarray(read_vc, np.int32),
+        )
+
+    # ------------------------------------------------------------------
     # stable time: the pmin collective
     # ------------------------------------------------------------------
     def _pmin(self):
@@ -236,6 +294,7 @@ class MeshServingPlane:
             "axis": SHARD_AXIS,
             "shards_per_device": self.cfg.n_shards // self.n_devices,
             "stable_collectives": self.stable_collectives,
+            "giant_folds": self.giant_folds,
         }
         m = self.metrics
         if m is not None:

@@ -66,11 +66,20 @@ def sharded_step_fn(ty, cfg, mesh: Mesh):
     enforces dynamically via its host-tracked ``max_abs_delta`` before
     choosing ITS pallas dispatch; here the check is yours).
     """
+    from antidote_tpu.materializer import pallas_kernels as pk
+
     read_body = _shard_read_body(ty, cfg)
-    pallas_counter = (
-        bool(getattr(cfg, "use_pallas", False)) and ty.name == "counter_pn"
+    # platform-gated like the store's strategy picker: interpret-mode
+    # kernels on CPU regress the step, they don't accelerate it
+    use_pallas = (bool(getattr(cfg, "use_pallas", False))
+                  and pk.in_path_ok())
+    pallas_counter = use_pallas and ty.name == "counter_pn"
+    pallas_set_aw = use_pallas and ty.name == "set_aw"
+    select_body = (
+        _shard_base_select_body(ty, cfg)
+        if (pallas_counter or pallas_set_aw)
+        else None
     )
-    select_body = _shard_base_select_body(ty, cfg) if pallas_counter else None
 
     def per_shard(snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,
                   app_rows, app_slots, app_a, app_b, app_vc, app_origin,
@@ -118,6 +127,20 @@ def sharded_step_fn(ty, cfg, mesh: Mesh):
                 ops_vc[rows_clip], read_n_ops, base_vc, read_vcs,
             )
             state = {"cnt": base_state["cnt"] + dcnt.astype(jnp.int64)}
+        elif pallas_set_aw:
+            # same shape: base select on this shard's block, then the
+            # fused add-wins fold kernel over the local ring slice — the
+            # BASELINE workload's own fold, shard-local on the mesh
+            from antidote_tpu.materializer import pallas_kernels as pk
+
+            base_state, base_vc, complete = select_body(
+                snap, snap_vc, snap_seq, rows_clip, read_vcs
+            )
+            state, applied = pk.set_aw_fold_local(
+                base_state, ops_a[rows_clip], ops_b[rows_clip],
+                ops_vc[rows_clip], ops_origin[rows_clip],
+                read_n_ops, base_vc, read_vcs,
+            )
         else:
             state, applied, complete = read_body(
                 snap, snap_vc, snap_seq, ops_a, ops_b, ops_vc, ops_origin,

@@ -1419,6 +1419,7 @@ class ProtocolServer:
             out["snapshot_cache"]["cap"] = txm.store.snapshot_cache_cap
             if txm.store.mesh is not None:
                 out["mesh"] = txm.store.mesh.status()
+            out["materializer"] = txm.store.materializer_status()
         return out
 
     # ------------------------------------------------------------------

@@ -430,6 +430,9 @@ class KVStore:
         self._bottom_cache: Dict[str, Dict[str, np.ndarray]] = {}
         #: keys promoted to a wider slot tier (observability + tests)
         self.promotions = 0
+        #: per-strategy replay-path fold dispatch counts (the
+        #: materializer status block; see _fold_over_ring)
+        self.replay_fold_dispatches: Dict[str, int] = {}
         #: type_name -> whether the type has slot accounting (cached so the
         #: apply_effects demand pre-pass skips unslotted effects cheaply)
         self._slotted: Dict[str, bool] = {}
@@ -569,13 +572,17 @@ class KVStore:
                 self.cfg.keys_per_table // (_TIER_SCALE ** tier), 16
             )
             t = TypedTable(
-                get_type(base), cfg, n_rows=n_rows, sharding=self.sharding
+                get_type(base), cfg, n_rows=n_rows, sharding=self.sharding,
+                metrics=self.metrics,
             )
             # out-of-band mutations (grow/promote/handoff) invalidate the
             # table's frozen serving buffers; the store-wide epoch that
             # references them must die with them
             t.on_serving_invalidate = self.drop_serving_epoch
             self.tables[tname] = t
+        if t.metrics is None and self.metrics is not None:
+            # metrics attach after store construction; adopt lazily
+            t.metrics = self.metrics
         return t
 
     def locate(self, key, type_name: str, bucket: str, create: bool = True):
@@ -1592,21 +1599,24 @@ class KVStore:
                 "checkpoint-truncated and no longer holds history below "
                 "the checkpoint stamp"
             )
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
         read_vc = np.asarray(read_vc, np.int32)
-        states = {}
         index = {}
+        ops: Dict[int, list] = {}
         for j, key, tname_t, bucket in wants:
             base, tier = split_tier(tname_t)
             ty = get_type(base)
             cfg_t = scaled_cfg(self.cfg, tier)
-            spec = ty.state_spec(cfg_t)
-            states[j] = {
-                f: jnp.zeros(shape, dtype) for f, (shape, dtype) in spec.items()
-            }
             index[(key, bucket)] = (j, ty, cfg_t)
+            ops[j] = []
+        # one host pass over the shard's log: collect each wanted key's
+        # visible effects in commit order (the sequence axis), then fold
+        # per key with the strategy the log's shape earns — this is where
+        # an over-ring celebrity key stops paying a length-L serial scan
         for rec in self.log.replay_shard(shard):
             hit = index.get((freeze_key(rec["k"]), rec["b"]))
             if hit is None:
@@ -1615,19 +1625,135 @@ class KVStore:
             vc = np.asarray(rec["vc"], np.int32)
             if not (vc <= read_vc).all():
                 continue
-            states[j] = ty.apply(
-                cfg_t, states[j],
-                jnp.asarray(_pad_lane(
-                    np.frombuffer(rec["a"], np.int64),
-                    ty.eff_a_width(cfg_t), np.int64,
-                )),
-                jnp.asarray(_pad_lane(
-                    np.frombuffer(rec["eb"], np.int32),
-                    ty.eff_b_width(cfg_t), np.int32,
-                )),
-                jnp.asarray(vc), jnp.int32(rec["o"]),
+            ops[j].append((
+                _pad_lane(np.frombuffer(rec["a"], np.int64),
+                          ty.eff_a_width(cfg_t), np.int64),
+                _pad_lane(np.frombuffer(rec["eb"], np.int32),
+                          ty.eff_b_width(cfg_t), np.int32),
+                vc, np.int32(rec["o"]),
+            ))
+        out = {}
+        for (key, bucket), (j, ty, cfg_t) in index.items():
+            spec = ty.state_spec(cfg_t)
+            state0 = {
+                f: jnp.zeros(shape, dtype)
+                for f, (shape, dtype) in spec.items()
+            }
+            recs = ops[j]
+            l = len(recs)
+            if l == 0:
+                out[j] = jax.tree.map(np.asarray, state0)
+                continue
+            ops_a = np.stack([r[0] for r in recs])
+            ops_b = np.stack([r[1] for r in recs])
+            ops_vc = np.stack([r[2] for r in recs])
+            ops_origin = np.asarray([r[3] for r in recs], np.int32)
+            base_vc = np.zeros((self.cfg.max_dcs,), np.int32)
+            t0 = _time.monotonic()
+            state, strategy = self._fold_over_ring(
+                ty, cfg_t, state0, ops_a, ops_b, ops_vc, ops_origin,
+                l, base_vc, read_vc,
             )
-        return {j: jax.tree.map(np.asarray, s) for j, s in states.items()}
+            out[j] = jax.tree.map(np.asarray, state)  # sync-ok: replay
+            # fallback path materializes host states for the caller
+            self._observe_fold(strategy, ty.name, _time.monotonic() - t0)
+        return out
+
+    def _fold_over_ring(self, ty, cfg_t, state0, ops_a, ops_b, ops_vc,
+                        ops_origin, l, base_vc, read_vc):
+        """Route one host-assembled op log (leading axis L, bottom base)
+        to a fold strategy; returns (device state pytree, strategy name).
+
+        Strategy ladder (docs/performance.md "Sequence-axis parallel
+        folds"):
+
+        * ``mesh_assoc`` — assoc-safe log of ≥ fold_chunk ops with a mesh
+          attached: op axis sharded over devices, partial deltas merged
+          in sequence order (``MeshServingPlane.fold_giant_key``).
+        * ``assoc`` — assoc-safe log: one O(log L)-depth delta window.
+          Assoc-safe = ``ty.supports_assoc``, plus (set_aw) an all-adds
+          log; the bottom base these replays start from satisfies
+          ``assoc_bottom_only`` by construction.
+        * ``long`` — order-sensitive log over fold_chunk ops: chunked
+          scan, zero-padded to a chunk multiple (pad slots sit at index
+          ≥ n_ops, so the inclusion mask drops them).
+        * ``serial`` — short order-sensitive log: plain masked scan.
+        """
+        from antidote_tpu.materializer import fold as fold_mod
+        from antidote_tpu.materializer import longlog
+
+        import jax.numpy as jnp
+
+        chunk = max(int(getattr(self.cfg, "fold_chunk", 4096)), 2)
+        assoc_ok = ty.supports_assoc and (
+            not ty.assoc_add_only or not (ops_b[:, 0] == 1).any()
+        )
+        n_ops = np.int32(l)
+        if assoc_ok and self.mesh is not None and l >= chunk:
+            state, _ = self.mesh.fold_giant_key(
+                ty, cfg_t, state0, ops_a, ops_b, ops_vc, ops_origin,
+                n_ops, base_vc, read_vc,
+            )
+            return state, "mesh_assoc"
+        if assoc_ok:
+            state, _ = longlog.assoc_fold(
+                ty, cfg_t, state0, jnp.asarray(ops_a), jnp.asarray(ops_b),
+                jnp.asarray(ops_vc), jnp.asarray(ops_origin), n_ops,
+                jnp.asarray(base_vc), jnp.asarray(read_vc),
+            )
+            return state, "assoc"
+        if l > chunk:
+            pad = (-l) % chunk
+
+            def padl(x):
+                return np.concatenate(
+                    [x, np.zeros((pad,) + x.shape[1:], x.dtype)]
+                ) if pad else x
+
+            state, _ = longlog.fold_long(
+                ty, cfg_t, state0, jnp.asarray(padl(ops_a)),
+                jnp.asarray(padl(ops_b)), jnp.asarray(padl(ops_vc)),
+                jnp.asarray(padl(ops_origin)), n_ops,
+                jnp.asarray(base_vc), jnp.asarray(read_vc), chunk=chunk,
+            )
+            return state, "long"
+        state, _ = fold_mod.fold_key(
+            ty, cfg_t, state0, jnp.asarray(ops_a), jnp.asarray(ops_b),
+            jnp.asarray(ops_vc), jnp.asarray(ops_origin), n_ops,
+            jnp.asarray(base_vc), jnp.asarray(read_vc),
+        )
+        return state, "serial"
+
+    def _observe_fold(self, strategy: str, tname: str, seconds: float):
+        """Tally a replay-path fold dispatch (host dict + metrics)."""
+        self.replay_fold_dispatches[strategy] = (
+            self.replay_fold_dispatches.get(strategy, 0) + 1
+        )
+        m = self.metrics
+        if m is not None:
+            fd = getattr(m, "fold_dispatch", None)
+            if fd is not None:
+                fd.inc(strategy=strategy)
+            fs = getattr(m, "fold_seconds", None)
+            if fs is not None:
+                fs.observe(seconds, strategy=strategy, type=tname)
+
+    def materializer_status(self) -> dict:
+        """The node-status ``materializer`` block: which fold strategies
+        the serving/replay paths actually dispatched, plus the knobs."""
+        per_table: Dict[str, int] = {}
+        for t in self.tables.values():
+            for s, n in t.fold_dispatches.items():
+                per_table[s] = per_table.get(s, 0) + n
+        out = {
+            "use_pallas": bool(getattr(self.cfg, "use_pallas", False)),
+            "fold_chunk": int(getattr(self.cfg, "fold_chunk", 4096)),
+            "serving_folds": per_table,
+            "replay_folds": dict(self.replay_fold_dispatches),
+        }
+        if self.mesh is not None:
+            out["giant_folds"] = self.mesh.giant_folds
+        return out
 
     def recover(self, track_origin: int | None = None) -> Dict:
         """Rebuild tables, clocks, blobs and op-id chains from the log

@@ -49,6 +49,7 @@ from antidote_tpu.clock import orddict
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.crdt.base import CRDTType
 from antidote_tpu.materializer import fold as fold_mod
+from antidote_tpu.materializer import longlog
 
 
 def _bucket(n: int, buckets) -> int:
@@ -196,9 +197,15 @@ class TypedTable:
         n_rows: int | None = None,
         n_shards: int | None = None,
         sharding=None,
+        metrics=None,
     ):
         self.ty = ty
         self.cfg = cfg
+        self.metrics = metrics
+        #: per-strategy serving-fold dispatch counts (host tallies; the
+        #: node status' materializer block and the
+        #: antidote_fold_dispatch_total metric read these)
+        self.fold_dispatches: Dict[str, int] = {}
         self.n_rows = n_rows or cfg.keys_per_table
         self.n_shards = n_shards or cfg.n_shards
         self.sharding = sharding
@@ -858,19 +865,22 @@ class TypedTable:
 
         return fn
 
-    def _read_resolved_fn(self, pallas_counter: bool, kmax: int = 0):
+    def _read_resolved_fn(self, strategy: str, kmax: int = 0):
         """The fused serving read: head gather + snapshot-version select +
         versioned ring fold + freshness select + device value resolution,
         all in ONE launch — the whole read path of SURVEY §3.3
         (check-freshness ≈ check_clock, fold ≈ clocksi_materializer:
         materialize, resolution ≈ Type:value) without intermediate host
-        round trips.  ``pallas_counter`` dispatches the counter-family fold
-        to the fused Pallas masked-sum kernel (VERDICT r1 item 3).
+        round trips.  ``strategy`` (from :meth:`_fold_strategy`) picks the
+        ring fold: ``pallas_counter``/``pallas_set_aw`` dispatch the fused
+        Pallas kernels (VERDICT r1 item 3; this PR puts the BASELINE
+        workload's own fold on a kernel), ``assoc`` the O(log K) monoid
+        reduction (materializer/longlog.py), ``serial`` the masked scan.
         ``kmax`` > 0 folds only ring slots [0, kmax) — valid whenever the
         host-tracked ``n_ops`` max over the batch is ≤ kmax (rings fill
         from 0 and reset at GC), cutting fold work from ops_per_key to the
         actual used prefix (r4 VERDICT item 4)."""
-        cached = self._resolved_fns.get((pallas_counter, kmax))
+        cached = self._resolved_fns.get((strategy, kmax))
         if cached is not None:
             return cached
         ty, cfg = self.ty, self.cfg
@@ -891,7 +901,7 @@ class TypedTable:
             else:
                 gat = jax.vmap(lambda x, r: x[r])
             opa, opv = gat(ops_a, rows), gat(ops_vc, rows)
-            if pallas_counter:
+            if strategy == "pallas_counter":
                 from antidote_tpu.materializer import pallas_kernels as pk
 
                 p, m = rows.shape
@@ -909,6 +919,32 @@ class TypedTable:
                     + dcnt.astype(jnp.int64).reshape(p, m)
                 }
                 applied = applied.reshape(p, m)
+            elif strategy == "pallas_set_aw":
+                from antidote_tpu.materializer import pallas_kernels as pk
+
+                p, m = rows.shape
+                opb, opo = gat(ops_b, rows), gat(ops_origin, rows)
+                flat = lambda x: x.reshape((p * m,) + x.shape[2:])
+                state_pm, applied = pk.set_aw_fold_local(
+                    {f: flat(x) for f, x in base_state.items()},
+                    flat(opa), flat(opb), flat(opv), flat(opo),
+                    n_ops_rows.reshape(p * m),
+                    base_vc.reshape(p * m, -1), read_vcs.reshape(p * m, -1),
+                    256, not pk._on_tpu(),
+                )
+                state_f = {
+                    f: x.reshape((p, m) + x.shape[1:])
+                    for f, x in state_pm.items()
+                }
+                applied = applied.reshape(p, m)
+            elif strategy == "assoc":
+                opb, opo = gat(ops_b, rows), gat(ops_origin, rows)
+                state_f, applied = jax.vmap(jax.vmap(
+                    lambda s, a, b, v, o, n, bv, rv: longlog.assoc_fold(
+                        ty, cfg, s, a, b, v, o, n, bv, rv
+                    )
+                ))(base_state, opa, opb, opv, opo, n_ops_rows, base_vc,
+                   read_vcs)
             else:
                 opb, opo = gat(ops_b, rows), gat(ops_origin, rows)
                 state_f, applied = jax.vmap(
@@ -931,7 +967,7 @@ class TypedTable:
             )
             return resolved, fresh, complete
 
-        self._resolved_fns[(pallas_counter, kmax)] = fn
+        self._resolved_fns[(strategy, kmax)] = fn
         return fn
 
     @functools.cached_property
@@ -957,13 +993,13 @@ class TypedTable:
 
         return fn
 
-    def _read_resolved_flat_fn(self, pallas_counter: bool, kmax: int = 0):
+    def _read_resolved_flat_fn(self, strategy: str, kmax: int = 0):
         """Flat single-gather variant of :meth:`_read_resolved_fn`: the
         same fused serving read (freshness + version select + ring fold +
         resolution, one launch) with the batch as the leading axis — the
         per-shard bodies run on pre-gathered rows via an identity index.
-        ``kmax`` as in :meth:`_read_resolved_fn`."""
-        cached = self._resolved_flat_fns.get((pallas_counter, kmax))
+        ``strategy``/``kmax`` as in :meth:`_read_resolved_fn`."""
+        cached = self._resolved_flat_fns.get((strategy, kmax))
         if cached is not None:
             return cached
         ty, cfg = self.ty, self.cfg
@@ -987,7 +1023,7 @@ class TypedTable:
                 opv = ops_vc[ss, rr][:, :kmax]
             else:
                 opa, opv = ops_a[ss, rr], ops_vc[ss, rr]
-            if pallas_counter:
+            if strategy == "pallas_counter":
                 from antidote_tpu.materializer import pallas_kernels as pk
 
                 k, d = opv.shape[1], opv.shape[2]
@@ -997,6 +1033,27 @@ class TypedTable:
                     256, not pk._on_tpu(),
                 )
                 state_f = {"cnt": base_state["cnt"] + dcnt.astype(jnp.int64)}
+            elif strategy == "pallas_set_aw":
+                from antidote_tpu.materializer import pallas_kernels as pk
+
+                opb, opo = ops_b[ss, rr], ops_origin[ss, rr]
+                if kmax:
+                    opb, opo = opb[:, :kmax], opo[:, :kmax]
+                state_f, applied = pk.set_aw_fold_local(
+                    base_state, opa, opb, opv, opo,
+                    n_ops_flat, base_vc, read_vcs,
+                    256, not pk._on_tpu(),
+                )
+            elif strategy == "assoc":
+                opb, opo = ops_b[ss, rr], ops_origin[ss, rr]
+                if kmax:
+                    opb, opo = opb[:, :kmax], opo[:, :kmax]
+                state_f, applied = jax.vmap(
+                    lambda s, a, b, v, o, n, bv, rv: longlog.assoc_fold(
+                        ty, cfg, s, a, b, v, o, n, bv, rv
+                    )
+                )(base_state, opa, opb, opv, opo, n_ops_flat, base_vc,
+                  read_vcs)
             else:
                 opb, opo = ops_b[ss, rr], ops_origin[ss, rr]
                 if kmax:
@@ -1020,7 +1077,7 @@ class TypedTable:
             )
             return resolved, fresh, complete
 
-        self._resolved_flat_fns[(pallas_counter, kmax)] = fn
+        self._resolved_flat_fns[(strategy, kmax)] = fn
         return fn
 
     @functools.cached_property
@@ -1104,7 +1161,9 @@ class TypedTable:
         n_ops_flat = self.n_ops[sss, rrs]
         n_ops_flat[ns:] = 0
         kmax = self._kmax_bucket(int(n_ops_flat.max()))
-        fn = self._read_resolved_flat_fn(self._pallas_counter_ok(), kmax)
+        strategy = self._fold_strategy()
+        self._count_dispatch(strategy)
+        fn = self._read_resolved_flat_fn(strategy, kmax)
         resolved_s, _, complete_s = fn(
             self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,
@@ -1282,6 +1341,16 @@ class TypedTable:
         out = {f: np.asarray(x)[s, j] for f, x in state.items()}
         return out, np.asarray(fresh)[s, j]
 
+    @staticmethod
+    def _pallas_platform_ok() -> bool:
+        """Pallas strategies need a real TPU backend to pay off — on CPU
+        the interpreter-mode kernels regress serve ~2x and mixed load
+        ~16x (see pallas_kernels.in_path_ok, which also honors the
+        ANTIDOTE_PALLAS_INTERPRET=1 parity-test escape)."""
+        from antidote_tpu.materializer import pallas_kernels as pk
+
+        return pk.in_path_ok()
+
     def _pallas_counter_ok(self) -> bool:
         return (
             getattr(self.cfg, "use_pallas", False)
@@ -1289,6 +1358,37 @@ class TypedTable:
             and self.max_abs_delta
             <= (2**31 - 1) // max(self.cfg.ops_per_key, 1)
         )
+
+    def _fold_strategy(self) -> str:
+        """Pick the ring fold for the serving read's stale remainder.
+
+        Pallas kernels first (TPU-gated — see ``_pallas_platform_ok``;
+        counter masked-sum when the i32 bound holds; the set_aw add-wins
+        fold — the BASELINE workload — needs no bound, it has no sums),
+        then the O(log K) assoc reduction for monoid types whose delta
+        is exact from an ARBITRARY base (counter without the kernel,
+        flags; sets are bottom-only — see
+        crdt/base.py::assoc_bottom_only), serial masked scan as fallback.
+        """
+        if self._pallas_counter_ok() and self._pallas_platform_ok():
+            return "pallas_counter"
+        if (
+            getattr(self.cfg, "use_pallas", False)
+            and self.ty.name == "set_aw"
+            and self._pallas_platform_ok()
+        ):
+            return "pallas_set_aw"
+        if self.ty.supports_assoc and not self.ty.assoc_bottom_only:
+            return "assoc"
+        return "serial"
+
+    def _count_dispatch(self, strategy: str, n: int = 1):
+        self.fold_dispatches[strategy] = (
+            self.fold_dispatches.get(strategy, 0) + n
+        )
+        m = getattr(self.metrics, "fold_dispatch", None)
+        if m is not None:
+            m.inc(n, strategy=strategy)
 
     def read_resolved_raw(self, shards, rows, read_vcs):
         """One-launch serving read; returns DEVICE arrays still in routed
@@ -1315,7 +1415,9 @@ class TypedTable:
         n_ops_mat = self.n_ops[np.arange(p)[:, None], row_gather]
         n_ops_mat = np.where(row_mat < self.n_rows, n_ops_mat, 0)
         kmax = self._kmax_bucket(int(n_ops_mat.max()) if n_ops_mat.size else 1)
-        fn = self._read_resolved_fn(self._pallas_counter_ok(), kmax)
+        strategy = self._fold_strategy()
+        self._count_dispatch(strategy)
+        fn = self._read_resolved_fn(strategy, kmax)
         resolved, fresh, complete = fn(
             self.head, self.head_vc, self.snap, self.snap_vc, self.snap_seq,
             self.ops_a, self.ops_b, self.ops_vc, self.ops_origin,

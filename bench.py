@@ -333,6 +333,15 @@ def child(args):
         vcs = vc_mid_b if (i % hist_every == hist_every - 1) else vc_final_b
         return table.read_resolved_flat(ss, rr, vcs)
 
+    def fold_snap():
+        return dict(table.fold_dispatches)
+
+    def fold_delta(before, after):
+        keys = set(before) | set(after)
+        d_ = {k: after.get(k, 0) - before.get(k, 0) for k in sorted(keys)}
+        return {k: v for k, v in d_.items() if v}
+
+    fold_pre_serve = fold_snap()
     # warmup/compile both VC variants; timed separately so a compile hang
     # (vs execute hang) localizes itself in the logs
     with phase("warmup_serve_fresh"):
@@ -374,6 +383,14 @@ def child(args):
         while q:
             np.asarray(q.popleft()["top"])
         serve_elapsed = time.perf_counter() - t0
+    fold_serve = fold_delta(fold_pre_serve, fold_snap())
+    # fresh-vs-historical latency split: the 1-in-hist_every batch is the
+    # one that pays the ring fold (strategy-dispatched); the rest resolve
+    # off the head and show the strategy-independent floor
+    lat_fresh_ms = [lat[i] * 1e3 for i in range(len(lat))
+                    if i % hist_every != hist_every - 1]
+    lat_hist_ms = [lat[i] * 1e3 for i in range(len(lat))
+                   if i % hist_every == hist_every - 1]
     serving_rps = serve_batches * serve_batch / serve_elapsed
     log(f"serving path: {serving_rps:,.0f} reads/s "
         f"(batch={serve_batch}, hist 1/{hist_every}, "
@@ -526,6 +543,7 @@ def child(args):
                      np.zeros(write_batch, np.int32))
         writes += write_batch
 
+    fold_pre_mixed = fold_snap()
     with phase("warmup_mixed"):
         # compile the append/GC/stale-serve shapes outside the timer —
         # several appends, because Zipfian hot-key chunking exercises a
@@ -548,6 +566,7 @@ def child(args):
         while mq:
             np.asarray(mq.popleft()["top"])
         mixed_elapsed = time.perf_counter() - t0
+    fold_mixed = fold_delta(fold_pre_mixed, fold_snap())
     mixed_read_rps = mixed_batches * serve_batch / mixed_elapsed
     mixed_write_rps = (writes - 6 * write_batch) / mixed_elapsed  # minus warmup
     log(f"mixed load: {mixed_read_rps:,.0f} reads/s + "
@@ -573,6 +592,17 @@ def child(args):
         "device_rtt_p50_ms": round(float(np.percentile(rtt_ms, 50)), 2),
         "use_pallas": bool(cfg.use_pallas),
         "platform": platform,
+        "fold_stage": {
+            # what the store's strategy picker routed the serving ring
+            # fold to, and how often each phase actually dispatched it
+            # (warmups included — they compile the same families)
+            "serving_strategy": table._fold_strategy(),
+            "dispatch_serve": fold_serve,
+            "dispatch_mixed": fold_mixed,
+            "serve_batch_fresh_ms_p50": round(
+                float(np.percentile(lat_fresh_ms, 50)), 2),
+            "serve_batch_hist_ms": [round(x, 2) for x in lat_hist_ms],
+        },
         "phases_s": phases,
     }))
     return 0

@@ -300,7 +300,7 @@ def _warm_shapes(cfg_id: int, smoke: bool = False) -> None:
     window as a multi-second p99 outlier.  One client walks those
     families before the ramp so every compile is ramp debt, exactly
     like the BEAM's missing compile debt the ramp already models."""
-    from antidote_tpu.proto.client import AntidoteClient
+    from antidote_tpu.proto.client import AntidoteClient, RemoteError
 
     cfg = CONFIGS[cfg_id]
     fn, obj = OP_FNS[cfg["op"]], OBJ_FNS[cfg["op"]]
@@ -318,6 +318,22 @@ def _warm_shapes(cfg_id: int, smoke: bool = False) -> None:
         if i % 32 == 0:
             fn(c, rng, 0, True)  # read the (possibly promoted) hot key
     fn(c, rng, 0, True)
+    # ISSUE 15: the strategy-dispatched REPLAY fold family.  A txn
+    # pinned BEFORE another overflow round goes stale-incomplete once GC
+    # reclaims its ring window, so its read walks the over-ring replay
+    # ladder (assoc / chunked long / serial per type) — since the store
+    # routes folds per strategy, these are separate XLA families from
+    # the serving fold the hammer above already compiled.  A server
+    # without a WAL refuses the replay with a typed error instead —
+    # nothing to warm there, keep walking.
+    txn = c.start_transaction()
+    for _ in range(writes // 2):
+        fn(c, rng, 0, False)
+    try:
+        txn.read_objects([obj(0)])
+        txn.commit()
+    except RemoteError:
+        txn.abort()
     # wide merged read: the >64-object padded bucket
     c.read_objects([obj(k) for k in range(100)])
     c.close()

@@ -192,9 +192,16 @@ def _check_unbounded_queues(tree, path, lines, problems) -> None:
 #: The mesh serving plane (antidote_tpu/parallel/, ISSUE 10) is held to
 #: the same bar: its launch/placement/collective paths run on
 #: dispatcher-stage threads, so a sync there must carry the same
-#: written justification.
+#: written justification.  The materializer plane
+#: (antidote_tpu/materializer/, ISSUE 15) joined when its folds became
+#: the live serving path: the Pallas kernels and the assoc/long-log
+#: strategies run inside jitted serving reads, where a stray sync
+#: serializes the whole launch pipeline.
 _SERVING_HOT_PATH = (os.path.join("antidote_tpu", "proto", "server.py"),)
-_SERVING_HOT_PLANES = (os.path.join("antidote_tpu", "parallel") + os.sep,)
+_SERVING_HOT_PLANES = (
+    os.path.join("antidote_tpu", "parallel") + os.sep,
+    os.path.join("antidote_tpu", "materializer") + os.sep,
+)
 _SYNC_TOKENS = ("block_until_ready(", ".item()", "np.asarray(")
 
 
@@ -215,10 +222,20 @@ def _check_serving_syncs(path, lines, problems) -> None:
         lo = max(0, lineno - 4)
         return any("sync-ok:" in ln for ln in lines[lo:lineno])
 
+    def hits(code: str, tok: str) -> bool:
+        # 'np.asarray(' must not match the trace-safe 'jnp.asarray('
+        start = 0
+        while (j := code.find(tok, start)) >= 0:
+            if not (tok == "np.asarray(" and j > 0
+                    and code[j - 1].isalnum()):
+                return True
+            start = j + 1
+        return False
+
     for i, ln in enumerate(lines, start=1):
         code = ln.split("#", 1)[0]
         for tok in _SYNC_TOKENS:
-            if tok in code and not annotated(i) and "sync-ok:" not in ln:
+            if hits(code, tok) and not annotated(i) and "sync-ok:" not in ln:
                 problems.append(
                     f"{path}:{i}: device-sync idiom '{tok}' in the "
                     "serving hot path — move it to the writeback stage "
