@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke hotkey-smoke lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -40,6 +40,24 @@ saturation:
 perf-smoke:
 	$(PY) bench_wire.py --perf-smoke --assert-bounds --json BENCH_WIRE_cpu.json
 	$(PY) bench_wire.py --perf-smoke-write --assert-bounds --json BENCH_WIRE_cpu.json
+
+# native planes (ISSUE 16): rebuild BOTH checked-in .so's (inter-DC
+# pump + serving front-end) with the ONE pinned flag set, embedding
+# each source's sha256; `native-check` fails CI when a checked-in
+# binary was built from different source than what's in the tree (the
+# drift a hand-run g++ line can't detect)
+native:
+	$(PY) -m antidote_tpu.native_build
+
+native-check:
+	$(PY) -m antidote_tpu.native_build --check
+
+# >=1k-socket accept-plane storm (ISSUE 16): structural gate only —
+# every socket connects AND gets served, zero protocol errors, and the
+# native front-end serves whole-batch hits with the fleet attached;
+# the frozen `sockets` entry in BENCH_WIRE_cpu.json is never a ratchet
+socket-storm:
+	$(PY) bench_wire.py --sockets 1024 --assert-bounds
 
 # checkpointed fast-restart smoke (ISSUE 8): populates through the
 # durable commit path, SIGKILLs, measures full-replay vs checkpoint+tail

@@ -242,6 +242,7 @@ def cmd_serve(args) -> int:
         port = server_box["srv"].port if "srv" in server_box else args.port
         server_box["srv"] = ProtocolServer(
             node, host=args.host, port=port, interdc=interdc,
+            max_connections=args.max_connections,
             max_in_flight=args.max_in_flight,
             max_in_flight_per_client=args.max_in_flight_per_client,
             default_deadline_ms=args.default_deadline_ms,
@@ -249,6 +250,7 @@ def cmd_serve(args) -> int:
             snapshot_cache_size=args.snapshot_cache_size,
             group_commit_window_us=args.group_commit_window_us,
             follower=follower,
+            native_frontend=args.native_frontend,
         )
         return server_box["srv"]
 
@@ -637,6 +639,18 @@ def main(argv=None) -> int:
                          "expected keyspace — every growth doubling "
                          "reallocates the device tables and recompiles "
                          "all serving shapes")
+    sv.add_argument("--native-frontend", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="own the client port from the C++ epoll "
+                         "front-end: accept, framing, admission and "
+                         "whole-batch cache hits run off the GIL "
+                         "(--no-native-frontend: the Python "
+                         "socketserver plane; also the automatic "
+                         "fallback when the module can't compile)")
+    sv.add_argument("--max-connections", type=int, default=1024,
+                    help="connection cap for the accept loop (native "
+                         "and Python planes alike); excess connections "
+                         "queue in the kernel listen backlog")
     sv.add_argument("--max-in-flight", type=int, default=256,
                     help="global admitted-request cap; past it the server "
                          "answers a typed busy error with a retry-after "

@@ -33,6 +33,7 @@ from antidote_tpu.faults.plan import (
     FaultInjector,
     FaultPlan,
     FaultRule,
+    armed_prefix,
     get_injector,
     hit,
     install,
@@ -44,6 +45,6 @@ from antidote_tpu.faults.plan import (
 
 __all__ = [
     "ACTIONS", "PLAN_ENV", "Decision", "FaultInjector", "FaultPlan",
-    "FaultRule", "get_injector", "hit", "install", "install_from_env",
-    "is_severed", "plan_from_env", "uninstall",
+    "FaultRule", "armed_prefix", "get_injector", "hit", "install",
+    "install_from_env", "is_severed", "plan_from_env", "uninstall",
 ]

@@ -362,3 +362,16 @@ def is_severed(a, b) -> bool:
     if inj is None:
         return False
     return inj.is_severed(a, b)
+
+
+def armed_prefix(prefix: str) -> bool:
+    """True when ANY armed rule targets a site under ``prefix`` — the
+    native front-end consults this at server start: with a
+    ``frontend.*`` rule armed it disables its in-C++ fast-serve path so
+    every frame crosses to Python, where the rule actually fires (a
+    natively-served hit would otherwise dodge the chaos plan)."""
+    inj = _ACTIVE
+    if inj is None:
+        return False
+    with inj._lock:
+        return any(r.site.startswith(prefix) for r in inj.rules)

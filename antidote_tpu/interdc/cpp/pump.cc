@@ -312,4 +312,10 @@ void pump_free(void* h) {
     // fabric lifecycle count; the kernel resources above are released.
 }
 
+#ifndef ANTIDOTE_SRC_SHA
+#define ANTIDOTE_SRC_SHA "unknown"
+#endif
+
+const char* pump_src_sha() { return ANTIDOTE_SRC_SHA; }
+
 }  // extern "C"

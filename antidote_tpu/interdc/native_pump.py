@@ -13,7 +13,6 @@ import ctypes
 import logging
 import os
 import pathlib
-import subprocess
 from typing import Optional, Tuple
 
 from antidote_tpu import faults
@@ -50,12 +49,11 @@ def _load_lib():
         return _lib
     _lib_tried = True
     try:
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 str(_SRC), "-o", str(_SO)],
-                check=True, capture_output=True,
-            )
+        # pinned-flag build through the shared helper (make native /
+        # make native-check provenance: the .so embeds its source sha)
+        from antidote_tpu import native_build
+
+        native_build.ensure(_SRC, _SO)
         lib = ctypes.CDLL(str(_SO))
         lib.pump_new.restype = ctypes.c_void_p
         lib.pump_new.argtypes = []

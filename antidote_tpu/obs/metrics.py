@@ -311,6 +311,11 @@ class NetMetrics:
             "Times the native receive plane was unavailable and the "
             "Python reader fallback engaged"
         )
+        self.frontend_fallback = Counter(
+            "antidote_native_frontend_fallback_total",
+            "Times the native serving front-end was unavailable and the "
+            "Python socketserver plane engaged"
+        )
         self.shard_moves = Counter(
             "antidote_cluster_shard_moves_total",
             "Live shard ownership moves (two-phase handoff legs)",
@@ -337,8 +342,9 @@ class NetMetrics:
                 self.corrupt_frames, self.catchup_failures,
                 self.rpc_retries, self.rpc_deadline_exceeded,
                 self.faults_injected, self.pump_fallback,
-                self.shard_moves, self.route_updates,
-                self.egress_window_drops, self.ingress_shed)
+                self.frontend_fallback, self.shard_moves,
+                self.route_updates, self.egress_window_drops,
+                self.ingress_shed)
 
     def attach(self, registry: "MetricsRegistry") -> None:
         """Register the shared counter objects into a node registry so

@@ -19,12 +19,14 @@ import itertools
 import logging
 import queue
 import socketserver
+import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from antidote_tpu import faults as _faults
 from antidote_tpu.api.node import AntidoteNode
 from antidote_tpu.overload import (
     AdmissionGate,
@@ -137,7 +139,8 @@ class ProtocolServer:
                  epoch_tick_ms: float = 100.0,
                  snapshot_cache_size: Optional[int] = None,
                  group_commit_window_us: float = 0.0,
-                 follower=None):
+                 follower=None, native_frontend: bool = False,
+                 native_mirror_cap: int = 1 << 18):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
@@ -319,13 +322,45 @@ class ProtocolServer:
                 finally:
                     conn_slots.release()
 
-        self._server = Server((host, port), handler)
+        # --- native serving front-end (ISSUE 16) -----------------------
+        #: a C++ epoll thread owning accept / framing / hot-read decode /
+        #: admission / whole-batch cache hits on the ADVERTISED port;
+        #: Python sees only drained misses, writes, txns and apb frames.
+        #: The socketserver plane stays bound (ephemeral port) as the
+        #: fallback path — and remains the only plane when the native
+        #: module can't load (NativeFrontend.create → None).
+        self.native = None
+        self._native_drain = None
+        if native_frontend:
+            from antidote_tpu.proto.native_frontend import NativeFrontend
+
+            self.native = NativeFrontend.create(
+                host, port, max_connections, max_in_flight,
+                max_in_flight_per_client, mirror_cap=native_mirror_cap)
+        self._server = Server(
+            (host, port if self.native is None else 0), handler)
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"antidote-proto:{self.port}",
         )
         self._thread.start()
+        if self.native is not None:
+            self.port = self.native.port
+            # fast-serve needs the epoch plane on an OWNER, and every
+            # armed frontend.* fault rule must keep firing — rules are
+            # applied Python-side per drained frame, so a natively-served
+            # hit would bypass them; with any armed, everything crosses
+            if (self._epoch_reads and self.follower is None
+                    and not _faults.armed_prefix("frontend.")):
+                self.node.txm.store.native_mirror = self.native
+            else:
+                self.native.set_fast_serve(False)
+            self._native_drain = threading.Thread(
+                target=self._native_drain_loop, daemon=True,
+                name="antidote-native-drain",
+            )
+            self._native_drain.start()
 
     # ------------------------------------------------------------------
     def _make_handler(server_self):
@@ -357,6 +392,12 @@ class ProtocolServer:
                     try:
                         frame = read_frame_buffered(rfile)
                     except (ConnectionError, OSError, ValueError):
+                        return
+                    # frontend.recv fault site — the Python-plane twin
+                    # of the native drain worker's (chaos parity: the
+                    # same plan wrecks frames on either accept path)
+                    frame = server_self._frame_fault(frame)
+                    if frame is None:
                         return
                     # ADMISSION (PR 4): acquire an in-flight slot before
                     # any decode/dispatch work.  Past the global or
@@ -402,95 +443,114 @@ class ProtocolServer:
 
             def _handle_admitted(self, frame, conn_txns) -> bool:
                 """One admitted request end-to-end; False = drop conn."""
-                # dialect dispatch on the code byte: antidote_pb
-                # request codes (apb.APB_REQUEST_CODES) are disjoint
-                # from the native msgpack codes, so existing
-                # antidotec_pb clients connect to the same port
-                if frame and frame[0] in apb.APB_REQUEST_CODES:
-                    # the apb dialect rides the SAME follower discipline
-                    # the native dialect has (ISSUE 11): session reads
-                    # pass the token gate, writes/txns answer typed
-                    # not_owner redirects — both errmsg-encoded on
-                    # ApbErrorResp (apb.handle_request consults
-                    # server.follower per request name)
-                    resp_body = apb.handle_request(
-                        server_self, frame[0], frame[1:], conn_txns,
-                        lock=server_self._lock,
-                    )
-                    try:
-                        write_frame_body(self.request, resp_body)
-                    except (ConnectionError, OSError):
-                        return False
-                    return True
+                buf = server_self._frame_reply(frame, conn_txns)
                 try:
-                    code, body = decode(frame)
-                    resp_code, resp = server_self._process(code, body)
-                    if code == MessageCode.START_TRANSACTION:
-                        conn_txns.add(resp["txid"])
-                    elif code in (MessageCode.COMMIT_TRANSACTION,
-                                  MessageCode.ABORT_TRANSACTION):
-                        conn_txns.discard(body.get("txid"))
-                except AbortError as e:
-                    if code == MessageCode.UPDATE_OBJECTS:
-                        conn_txns.discard(body.get("txid"))
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "aborted", "detail": str(e)
-                    }
-                except BusyError as e:
-                    # downstream cap (commit backlog / batch gate):
-                    # same typed shape as the admission shed
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "busy", "detail": str(e),
-                        "retry_after_ms": int(e.retry_after_ms),
-                    }
-                except DeadlineExceeded as e:
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "deadline", "detail": str(e)
-                    }
-                except ReplicaLagging as e:
-                    # follower session gate: the read was NOT served —
-                    # the client retries after the hint or fails over
-                    # (the redirect names the owner)
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "lagging", "detail": str(e),
-                        "retry_after_ms": int(e.retry_after_ms),
-                        "redirect": e.redirect,
-                    }
-                except ColdMiss as e:
-                    # cold-tier fault-in refused (rate cap / I/O fault /
-                    # CRC failure): the key's device row stays cold this
-                    # round — the client retries after the hint; the
-                    # value was NEVER served wrong
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "cold_miss", "detail": str(e),
-                        "retry_after_ms": int(e.retry_after_ms),
-                        "permanent": bool(e.permanent),
-                    }
-                except NotOwnerError as e:
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "not_owner", "detail": str(e),
-                        "redirect": e.redirect,
-                    }
-                except ReadOnlyError as e:
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": "read_only", "detail": str(e)
-                    }
-                except Exception as e:  # error reply, keep the conn
-                    log.exception("request failed")
-                    resp_code, resp = MessageCode.ERROR_RESP, {
-                        "error": type(e).__name__, "detail": str(e)
-                    }
-                try:
-                    if isinstance(resp, RawReply):
-                        # the writeback stage already framed the reply
-                        self.request.sendall(resp.buf)
-                    else:
-                        write_message(self.request, resp_code, resp)
+                    # py-socket-ok: socketserver fallback plane — with
+                    # the native front-end on, client replies leave
+                    # through frontend_send instead
+                    self.request.sendall(buf)
                 except (ConnectionError, OSError):
                     return False
                 return True
 
         return Handler
+
+    # ------------------------------------------------------------------
+    # shared serving core (socket handlers + native drain workers)
+    # ------------------------------------------------------------------
+    def _frame_reply(self, frame: bytes, conn_txns) -> bytes:
+        """One request frame → one fully-framed reply, both dialects —
+        the serving core behind the socket Handler AND the native drain
+        workers (admission is the caller's job; the error mapping here
+        mirrors antidote_pb_protocol:handle's error replies)."""
+        # dialect dispatch on the code byte: antidote_pb request codes
+        # (apb.APB_REQUEST_CODES) are disjoint from the native msgpack
+        # codes, so existing antidotec_pb clients connect to the same
+        # port — and ride the SAME follower discipline (ISSUE 11)
+        if frame and frame[0] in apb.APB_REQUEST_CODES:
+            resp_body = apb.handle_request(
+                self, frame[0], frame[1:], conn_txns, lock=self._lock,
+            )
+            return struct.pack(">I", len(resp_body)) + resp_body
+        code = body = None
+        try:
+            code, body = decode(frame)
+            resp_code, resp = self._process(code, body)
+            if code == MessageCode.START_TRANSACTION:
+                conn_txns.add(resp["txid"])
+            elif code in (MessageCode.COMMIT_TRANSACTION,
+                          MessageCode.ABORT_TRANSACTION):
+                conn_txns.discard(body.get("txid"))
+        except AbortError as e:
+            if code == MessageCode.UPDATE_OBJECTS:
+                conn_txns.discard(body.get("txid"))
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "aborted", "detail": str(e)
+            }
+        except BusyError as e:
+            # downstream cap (commit backlog / batch gate): same typed
+            # shape as the admission shed
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "busy", "detail": str(e),
+                "retry_after_ms": int(e.retry_after_ms),
+            }
+        except DeadlineExceeded as e:
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "deadline", "detail": str(e)
+            }
+        except ReplicaLagging as e:
+            # follower session gate: the read was NOT served — the
+            # client retries after the hint or fails over (the redirect
+            # names the owner)
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "lagging", "detail": str(e),
+                "retry_after_ms": int(e.retry_after_ms),
+                "redirect": e.redirect,
+            }
+        except ColdMiss as e:
+            # cold-tier fault-in refused (rate cap / I/O fault / CRC
+            # failure): the key's device row stays cold this round —
+            # the client retries after the hint; the value was NEVER
+            # served wrong
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "cold_miss", "detail": str(e),
+                "retry_after_ms": int(e.retry_after_ms),
+                "permanent": bool(e.permanent),
+            }
+        except NotOwnerError as e:
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "not_owner", "detail": str(e),
+                "redirect": e.redirect,
+            }
+        except ReadOnlyError as e:
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "read_only", "detail": str(e)
+            }
+        except Exception as e:  # error reply, keep the conn
+            log.exception("request failed")
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": type(e).__name__, "detail": str(e)
+            }
+        if isinstance(resp, RawReply):
+            # the writeback stage already framed the reply
+            return resp.buf
+        return encode(resp_code, resp)
+
+    def _frame_fault(self, frame: bytes) -> Optional[bytes]:
+        """Apply an armed ``frontend.recv`` fault rule to one inbound
+        frame (chaos: the native accept path and the Python plane share
+        this site).  None = drop the connection."""
+        d = _faults.hit("frontend.recv")
+        if d is None:
+            return frame
+        if d.action == "drop":
+            return None
+        if d.action == "truncate":
+            keep = int(d.arg) if d.arg else max(1, len(frame) // 2)
+            return frame[:keep]
+        if d.action == "delay":
+            time.sleep(float(d.arg or 0.01))
+        return frame
 
     def _abort_orphan(self, txid: int) -> None:
         """Roll back a transaction whose client connection died."""
@@ -498,6 +558,119 @@ class ProtocolServer:
             txn = self._txns.pop(txid, None)
             if txn is not None and txn.active:
                 self.node.abort_transaction(txn)
+
+    # ------------------------------------------------------------------
+    # native front-end drain plane (ISSUE 16)
+    # ------------------------------------------------------------------
+    def _native_drain_loop(self):
+        """Fans batch-drain crossings out to per-connection workers.
+
+        The C++ loop serves whole-batch cache hits itself; everything it
+        can't (misses, writes, interactive txns, apb frames, admission
+        sheds) crosses here in packed batches — ONE GIL acquisition per
+        drain, then per-conn queues so one slow device batch never
+        head-of-line-blocks another connection's frames.  Reply order
+        per connection is preserved: the native loop only fast-serves a
+        conn with no frame still pending in Python."""
+        nf = self.native
+        workers: Dict[int, "queue.SimpleQueue"] = {}
+        while not self._closing:
+            batch = nf.take_batch(200)
+            now = time.monotonic()
+            for conn_id, kind, aux, payload in batch:
+                if kind == nf.K_CONN_DROP:
+                    q = workers.pop(conn_id, None)
+                    if q is not None:
+                        q.put(None)
+                    continue
+                q = workers.get(conn_id)
+                if q is None:
+                    # admitted frames hold admission slots until
+                    # frontend_send releases them, and the native loop
+                    # stops reading sockets when its crossing queue
+                    # fills — so this queue's depth is
+                    # bounded-by: admission caps + native QUEUE_CAP
+                    q = queue.SimpleQueue()
+                    workers[conn_id] = q
+                    threading.Thread(
+                        target=self._native_conn_worker, daemon=True,
+                        args=(conn_id, q),
+                        name=f"antidote-native-conn-{conn_id}",
+                    ).start()
+                q.put((kind, aux, payload, now))
+        for q in workers.values():
+            q.put(None)
+
+    def _native_conn_worker(self, conn_id: int, q: "queue.SimpleQueue"):
+        """One drained connection's serving thread — the moral twin of a
+        socketserver Handler: same fault site, same serving core, same
+        orphan-txn rollback when the conn drops."""
+        nf = self.native
+        conn_txns = set()
+        try:
+            while True:
+                item = q.get()
+                if item is None or self._closing:
+                    return
+                kind, aux, frame, t0 = item
+                admitted = 1 if kind == nf.K_FRAME else 0
+                frame = self._frame_fault(frame)
+                if frame is None:
+                    # chaos drop: account the slot, then drop the conn —
+                    # the Python plane's silent-close twin
+                    nf.send(conn_id, b"", admitted)
+                    nf.close_conn(conn_id)
+                    continue
+                if kind == nf.K_SHED:
+                    # the native loop refused admission; serialize the
+                    # typed busy reply in the frame's dialect here
+                    # (Python owns the apb encoder)
+                    self.metrics.shed.inc(plane="server")
+                    nf.send(conn_id, self._busy_reply_bytes(frame, aux), 0)
+                    continue
+                self._tls.t0 = t0
+                try:
+                    buf = self._frame_reply(frame, conn_txns)
+                except Exception as e:  # never wedge the admission slot
+                    log.exception("native drain request failed")
+                    buf = encode(MessageCode.ERROR_RESP, {
+                        "error": type(e).__name__, "detail": str(e)})
+                nf.send(conn_id, buf, admitted)
+                self.metrics.server_request_seconds.observe(
+                    time.monotonic() - t0)
+        finally:
+            for txid in conn_txns:
+                self._abort_orphan(txid)
+
+    def _busy_reply_bytes(self, frame: bytes, hint_ms: int) -> bytes:
+        """Framed admission-shed reply in the frame's dialect (the
+        native loop sheds apb frames to Python — kind 2 — because the
+        apb error encoder lives here)."""
+        if frame and frame[0] in apb.APB_REQUEST_CODES:
+            body = apb.overload_error(
+                "busy", "server admission refused", int(hint_ms))
+            return struct.pack(">I", len(body)) + body
+        return encode(MessageCode.ERROR_RESP, {
+            "error": "busy", "detail": "server admission refused",
+            "retry_after_ms": int(hint_ms),
+        })
+
+    def _native_advance(self) -> None:
+        """Push the freshly-published serving epoch to the C++ mirror —
+        called by the epoch ticker right after every publish.  The
+        mirror's re-stamping is sound because every effect applied since
+        the last advance invalidated its keys eagerly (under the commit
+        lock, BEFORE the publish made them visible)."""
+        nf = self.native
+        txm = self.node.txm
+        if nf is None or getattr(txm.store, "native_mirror", None) is not nf:
+            return
+        ep = txm.store.serving_epoch
+        if ep is None:
+            nf.set_clockless_ok(False)
+            return
+        nf.advance(int(ep.id), [int(x) for x in ep.vc],
+                   int(ep.vc[txm.my_dc]) >= txm.epoch_lag_counter)
 
     # ------------------------------------------------------------------
     # static batch gate
@@ -923,6 +1096,7 @@ class ProtocolServer:
             try:
                 if self._epoch_reads:
                     txm.publish_serving_epoch()
+                    self._native_advance()
                 self._publish_table_epochs_capped()
             except Exception:
                 log.exception("epoch ticker publish failed")
@@ -1413,6 +1587,8 @@ class ProtocolServer:
             "locked_depth": self._locked_q.qsize(),
             "group_commit_window_us": round(self._group_window_s * 1e6, 1),
         }
+        if self.native is not None:
+            out["native"] = self.native.stats()
         txm = getattr(self.node, "txm", None)
         if txm is not None:
             out["snapshot_cache"]["size"] = len(txm.store.snapshot_cache)
@@ -1432,6 +1608,16 @@ class ProtocolServer:
         self._ticker_stop.set()
         self._server.shutdown()
         self._server.server_close()
+        if self.native is not None:
+            # unwire the mirror FIRST: kv.py must stop pushing into a
+            # handle about to be quarantined
+            txm = getattr(self.node, "txm", None)
+            if txm is not None and getattr(txm.store, "native_mirror",
+                                           None) is self.native:
+                txm.store.native_mirror = None
+            self.native.close()
+            if self._native_drain is not None:
+                self._native_drain.join(timeout=5)
         if self.batch_static:
             # the gate is bounded now: a full queue + wedged dispatcher
             # must not turn close() into a forever-blocking put

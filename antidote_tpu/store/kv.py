@@ -509,6 +509,12 @@ class KVStore:
         #: MerkleIndex for split divergence digests (built lazily by the
         #: replica planes; None until the first tree is requested)
         self.merkle = None
+        #: NativeFrontend mirror (ISSUE 16) — the C++ serving loop's
+        #: epoch-stamped copy of the snapshot cache.  Wired by the
+        #: protocol server when native whole-batch serving is on;
+        #: pushed from the fill/invalidate/drop paths below so the
+        #: native plane can never serve a value Python would not.
+        self.native_mirror = None
         #: (key, bucket) pairs written/born/promoted since the last
         #: checkpoint capture — the incremental chain's dirty-key window.
         #: None = untracked overflow: the next stamp must rebase.
@@ -544,6 +550,10 @@ class KVStore:
                 eps.append(self.serving_epoch)
         for e in eps:
             e.promoted.add(dk)
+        nm = self.native_mirror
+        if nm is not None:
+            # epoch-ineligible key: the native mirror must miss too
+            nm.invalidate(dk[0], dk[1])
 
     def drop_cached_value(self, dk) -> None:
         """Invalidate both decoded-value caches for one key (eviction /
@@ -552,6 +562,9 @@ class KVStore:
             self._value_cache.pop(dk, None)
         with self._snapshot_cache_lock:
             self.snapshot_cache.pop(dk, None)
+        nm = self.native_mirror
+        if nm is not None:
+            nm.invalidate(dk[0], dk[1])
 
     def _is_slotted(self, type_name: str) -> bool:
         hit = self._slotted.get(type_name)
@@ -705,6 +718,16 @@ class KVStore:
     def _apply_effect_groups_inner(self, groups, defer_sync):
         effects = [e for g in groups for e in g[0]]
         self.locate_many([(e.key, e.type_name, e.bucket) for e in effects])
+        nm = self.native_mirror
+        if nm is not None:
+            # EAGER native-mirror invalidation, under the commit lock,
+            # BEFORE any table observes the effects: the C++ loop can
+            # at worst keep serving the pre-commit value at the current
+            # epoch stamp (exactly what the Python cache serves until
+            # the next publish), never a torn or stale-at-epoch one —
+            # this ordering is what makes advance()'s re-stamping sound
+            for dk in {(e.key, e.bucket) for e in effects}:
+                nm.invalidate(dk[0], dk[1])
         # ---- overflow escape hatch: promote BEFORE anything can drop.
         # Aggregate each key's worst-case fresh-slot demand (+ the minimum
         # tier its effect lanes require — a remote DC may ship wider
@@ -864,6 +887,10 @@ class KVStore:
             if ep is not None:
                 self.serving_epoch = None
                 self._epoch_graveyard.append(ep)
+        nm = self.native_mirror
+        if nm is not None:
+            # no epoch, no native serving — until the next advance()
+            nm.reset()
 
     def publish_serving_epoch(self, vc: np.ndarray) -> str:
         """Publish a new store-wide serving snapshot at clock ``vc``.
@@ -1000,17 +1027,27 @@ class KVStore:
             ent = self.directory.get(dk)
             if dk in ep.promoted:
                 return None
+            nm = self.native_mirror
             if ent is None:
                 if self.cold is not None and self.cold.is_cold(dk):
                     return None  # cold key: the locked path faults it in
-                vals.append(self._bottom_value(type_name))
+                bottom = self._bottom_value(type_name)
+                if nm is not None:
+                    # teach the native mirror the bottom: its first
+                    # write invalidates eagerly, so serving it at ep is
+                    # exactly what this path serves
+                    nm.fill(key, bucket, type_name, bottom, ep.id)
+                vals.append(bottom)
                 continue
             tname_t, shard, row = ent
             ur = ep.used_rows.get(tname_t)
             if (split_tier(tname_t)[0] == type_name and ur is not None
                     and row >= ur[shard]):
                 # row born after the epoch: bottom at E
-                vals.append(self._bottom_value(type_name))
+                bottom = self._bottom_value(type_name)
+                if nm is not None:
+                    nm.fill(key, bucket, type_name, bottom, ep.id)
+                vals.append(bottom)
                 continue
             return None  # needs a frozen-head gather (or the locked path)
         if self.metrics is not None:
@@ -1060,6 +1097,14 @@ class KVStore:
                     else:
                         self.snapshot_cache[dk] = (ep.id, loc, value)
                         ok = True
+                        nm = self.native_mirror
+                        if nm is not None:
+                            # re-prove the entry to the native mirror
+                            # too (its advance() only carries entries
+                            # stamped at the previous epoch — Python's
+                            # touch-log walk can bridge longer gaps)
+                            nm.fill(dk[0], dk[1], split_tier(loc[0])[0],
+                                    value, ep.id)
                 if ok:
                     self.snapshot_cache.move_to_end(dk)
                     if m is not None:
@@ -1076,6 +1121,9 @@ class KVStore:
                 self.snapshot_cache.popitem(last=False)
                 if self.metrics is not None:
                     self.metrics.snapshot_cache.inc(event="evict")
+        nm = self.native_mirror
+        if nm is not None:
+            nm.fill(dk[0], dk[1], split_tier(loc[0])[0], value, ep.id)
 
     def _bottom_value(self, type_name: str):
         """Decoded client-visible value of a never-written key."""

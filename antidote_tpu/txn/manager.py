@@ -236,6 +236,17 @@ class TransactionManager:
     def _publish_serving_epoch_locked(self) -> str:
         return self.store.publish_serving_epoch(self.serving_epoch_vc())
 
+    def _native_lag_raised(self) -> None:
+        """The serving epoch just started lagging the commit counter:
+        the native front-end must stop serving clockless reads from it
+        (Python's ``_try_cache_read`` refuses via ``epoch_lag_counter``;
+        the C++ loop learns the same fact here).  The next successful
+        advance — server epoch ticker, after a publish that catches up —
+        re-enables it."""
+        nm = getattr(self.store, "native_mirror", None)
+        if nm is not None:
+            nm.set_clockless_ok(False)
+
     @property
     def checkpoint_barrier(self):
         """The lock a checkpoint stamp must hold (ISSUE 8): under it, no
@@ -698,6 +709,7 @@ class TransactionManager:
                             if (idle and now2 - self._last_inline_publish
                                     < self.EPOCH_INLINE_PUBLISH_S):
                                 self.epoch_lag_counter = self.commit_counter
+                                self._native_lag_raised()
                             else:
                                 self._last_inline_publish = now2
                                 self._reads_at_last_publish = reads_now
@@ -710,6 +722,7 @@ class TransactionManager:
                                 if st not in ("published", "noop"):
                                     self.epoch_lag_counter = (
                                         self.commit_counter)
+                                    self._native_lag_raised()
                     except OSError as e:
                         if has_writes and e.errno in (errno.ENOSPC,
                                                       errno.EIO,

@@ -47,6 +47,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -119,9 +120,12 @@ def _stage_delta(pre, post):
             "mean_us": round(s * 1e3 / n, 1) if n else 0.0,
         }
     out["epoch_publish"] = {}
-    for blk in ("reads", "snapshot_cache", "epoch_publish"):
+    if "native" in post:
+        out["native"] = {}
+    for blk in ("reads", "snapshot_cache", "epoch_publish", "native"):
         for k, v in post.get(blk, {}).items():
-            if k in ("size", "cap"):
+            if k in ("size", "cap", "mirror_size", "in_flight",
+                     "open_conns"):
                 out[blk][k] = v  # absolute, not a counter
             elif isinstance(v, (int, float)):
                 out[blk][k] = v - pre.get(blk, {}).get(k, 0)
@@ -890,8 +894,20 @@ def bench_perf_smoke(assert_bounds: bool, json_path=None):
             assert out["read_ops_per_s"] >= floor, (
                 f"read throughput regressed: {out['read_ops_per_s']} ops/s "
                 f"< 0.8 x frozen {frozen} ops/s")
+            # STRUCTURAL native gate (ISSUE 16): when the server runs
+            # the native front-end (the serve default), the measured
+            # window must contain whole-batch hits served in C++ — a
+            # silently-disabled fast path would otherwise pass on
+            # throughput luck alone.  Skipped when the native module
+            # could not load (the artifact then has no native block).
+            native = (out.get("pipeline") or {}).get("native")
+            if native is not None:
+                assert native.get("native_hits", 0) > 0, (
+                    "native front-end active but served 0 whole-batch "
+                    f"hits in the measured window: {native}")
             print(f"perf-smoke OK: {out['read_ops_per_s']} >= "
-                  f"{round(floor, 1)} (0.8 x frozen {frozen})")
+                  f"{round(floor, 1)} (0.8 x frozen {frozen}; native "
+                  f"hits {0 if native is None else native.get('native_hits')})")
         return out
     finally:
         for p in procs:
@@ -968,6 +984,174 @@ def bench_perf_smoke_write(assert_bounds: bool, json_path=None):
                 f"< 0.8 x frozen {frozen} ops/s")
             print(f"perf-smoke-write OK: {out['ops_per_s']} >= "
                   f"{round(floor, 1)} (0.8 x frozen {frozen})")
+        return out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+# socket storm: the >=1k-connection accept-plane mode (ISSUE 16)
+# ---------------------------------------------------------------------------
+#: frozen storm shape: N sockets from ONE driver process (selectors,
+#: not thread-per-conn — a thousand Python threads would measure the
+#: driver), every socket cycling clockless single-key reads over a
+#: prefilled keyspace.  The point is the ACCEPT PLANE: connection
+#: setup at scale, per-conn framing state, and whole-batch hits served
+#: with a thousand sockets open — not peak throughput (one driver core
+#: caps that).
+SOCKET_STORM = {"sockets": 1024, "keys": 2048, "prefill": 512,
+                "duration_s": 6}
+
+
+def bench_sockets(n_sockets: int, assert_bounds: bool, json_path=None):
+    """--sockets N: open N concurrent connections against one node and
+    drive a read on every socket round-robin via ``selectors`` for the
+    storm window.  Structural gates under --assert-bounds: every socket
+    connects AND receives at least one reply, zero protocol errors; on
+    a native-front-end server the window must contain native hits with
+    the fleet attached.  Frozen under ``sockets`` in the wire artifact
+    (never a throughput ratchet — see host_note)."""
+    import selectors
+
+    import msgpack
+
+    global HOST, PORT
+    ps = dict(SOCKET_STORM)
+    if n_sockets:
+        ps["sockets"] = int(n_sockets)
+    n = ps["sockets"]
+    procs, info = _spawn_server(
+        16, keys_hint=ps["keys"],
+        extra=("--max-connections", str(n + 64)))
+    HOST, PORT = info["host"], info["port"]
+    try:
+        from antidote_tpu.proto.client import AntidoteClient
+        from antidote_tpu.proto.codec import MessageCode, encode
+
+        c = AntidoteClient(HOST, PORT)
+        for base in range(0, ps["prefill"], 64):
+            c.update_objects([
+                (k, "counter_pn", "b", ("increment", k + 1))
+                for k in range(base, min(base + 64, ps["prefill"]))
+            ])
+        # storm sockets read single keys; warm that wire shape once
+        c.read_objects([(0, "counter_pn", "b")])
+        c.close()
+
+        def read_req(k):
+            return encode(MessageCode.STATIC_READ_OBJECTS,
+                          {"objects": [[k, "counter_pn", "b"]],
+                           "clock": None})
+
+        t_conn0 = time.perf_counter()
+        sel = selectors.DefaultSelector()
+        socks = []
+        for i in range(n):
+            s = socket.create_connection((HOST, PORT), timeout=30)
+            # sockets stay BLOCKING: recv fires only after EVENT_READ
+            # (never blocks), and sendall on a blocking socket cannot
+            # partial-write — one less failure mode than nonblocking +
+            # manual write buffering, at no cost for 13-byte requests
+            s.settimeout(None)
+            # state: [recv buffer, replies, next key, pending]
+            sel.register(s, selectors.EVENT_READ,
+                         [bytearray(), 0, i % ps["keys"], False])
+            socks.append(s)
+        connect_s = round(time.perf_counter() - t_conn0, 3)
+        pre = _pipeline_probe()
+        errors = 0
+        sheds = 0
+        total = 0
+        stop = time.perf_counter() + ps["duration_s"]
+        # prime one in-flight read per socket (closed loop per conn)
+        for s in socks:
+            st = sel.get_key(s).data
+            s.sendall(read_req(st[2]))
+            st[3] = True
+        while time.perf_counter() < stop:
+            for key, _ in sel.select(timeout=0.2):
+                s, st = key.fileobj, key.data
+                try:
+                    chunk = s.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    errors += 1
+                    sel.unregister(s)
+                    continue
+                if not chunk:
+                    errors += 1
+                    sel.unregister(s)
+                    continue
+                buf = st[0]
+                buf.extend(chunk)
+                while len(buf) >= 4:
+                    ln = int.from_bytes(buf[:4], "big")
+                    if len(buf) < 4 + ln:
+                        break
+                    frame = bytes(buf[4:4 + ln])
+                    del buf[:4 + ln]
+                    if frame[0] != int(MessageCode.READ_OBJECTS_RESP):
+                        # typed busy sheds are the admission plane
+                        # holding its cap against 1k closed-loop
+                        # sockets — expected under storm, counted
+                        # apart from real protocol errors
+                        body = msgpack.unpackb(frame[1:], raw=False)
+                        if (frame[0] == int(MessageCode.ERROR_RESP)
+                                and body.get("error") == "busy"
+                                and body.get("retry_after_ms")):
+                            sheds += 1
+                        else:
+                            errors += 1
+                    else:
+                        st[1] += 1
+                        total += 1
+                    st[2] = (st[2] + 17) % ps["keys"]
+                    s.sendall(read_req(st[2]))
+        served = sum(key.data[1] for key in
+                     sel.get_map().values())
+        silent = sum(1 for key in sel.get_map().values()
+                     if key.data[1] == 0)
+        pipeline = _stage_delta(pre, _pipeline_probe())
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        out = {
+            "config": "socket_storm",
+            "sockets": n,
+            "connect_s": connect_s,
+            "ops": total,
+            "ops_per_s": round(total / ps["duration_s"], 1),
+            "errors": errors,
+            "busy_sheds": sheds,
+            "sockets_unserved": silent,
+            "driver": {"rev": DRIVER_REV, **ps},
+        }
+        if pipeline:
+            out["pipeline"] = pipeline
+        print(json.dumps(out), flush=True)
+        if assert_bounds:
+            assert errors == 0, f"{errors} socket/protocol errors"
+            assert silent == 0, (
+                f"{silent}/{n} sockets never received a reply")
+            native = (out.get("pipeline") or {}).get("native")
+            if native is not None:
+                assert native.get("native_hits", 0) > 0, (
+                    "native front-end active but 0 whole-batch hits "
+                    f"under the {n}-socket storm: {native}")
+                assert native.get("open_conns", 0) >= n, (
+                    f"native plane reports {native.get('open_conns')} "
+                    f"open conns with {n} sockets attached")
+            print(f"socket-storm OK: {n} sockets, "
+                  f"{out['ops_per_s']} ops/s, 0 errors")
         return out
     finally:
         for p in procs:
@@ -1163,7 +1347,13 @@ def bench_follower_fanout(smoke: bool, assert_bounds: bool = False,
                "is COVERAGE: zero session violations and every ring "
                "arc served.  On a host with >= n_followers+1 cores the "
                "owner offload is the whole point — reads never touch "
-               "it.")}
+               "it.  Re-frozen behind the native accept plane (ISSUE "
+               "16): every endpoint's C++ front-end owns accept/framing"
+               "/admission, but session reads are CLOCKED so they all "
+               "cross to Python — the native fast path cannot help "
+               "this curve, and the >4-follower inversion is "
+               "unchanged: it is core contention, not accept-plane "
+               "overhead.")}
     print(json.dumps(out), flush=True)
     if assert_bounds:
         # STRUCTURAL gate: the session guarantees held at every fanout
@@ -1221,6 +1411,14 @@ def main():
                          "AND every follower's ring arcs served reads "
                          "(`make fleet-smoke`; never freezes, never a "
                          "throughput ratchet)")
+    ap.add_argument("--sockets", type=int, default=0, metavar="N",
+                    help="socket-storm mode: open N concurrent "
+                         "connections (>=1k exercises the native "
+                         "accept plane) and cycle reads on all of "
+                         "them; frozen under `sockets` in the wire "
+                         "artifact.  With --assert-bounds: structural "
+                         "gate only (every socket served, zero errors, "
+                         "native hits under storm)")
     ap.add_argument("--assert-bounds", action="store_true",
                     help="with --saturation: fail unless goodput stays "
                          "within 20%% of peak past the knee (the `make "
@@ -1262,6 +1460,13 @@ def main():
         bench_follower_fanout(smoke, assert_bounds=args.assert_bounds,
                               json_path=path)
         return 0
+    if args.sockets:
+        out = bench_sockets(args.sockets, args.assert_bounds,
+                            json_path=args.json)
+        if args.json and not args.assert_bounds:
+            # same no-ratchet discipline as the perf-smoke gates
+            _write_artifact(args.json, sockets=out)
+        return 0
     if args.perf_smoke:
         out = bench_perf_smoke(args.assert_bounds, json_path=args.json)
         if args.json and not args.assert_bounds:
@@ -1296,7 +1501,8 @@ def main():
 
 
 def _write_artifact(path, results=None, saturation=None, perf_smoke=None,
-                    perf_smoke_write=None, follower_fanout=None):
+                    perf_smoke_write=None, follower_fanout=None,
+                    sockets=None):
     """Merge this run into the artifact instead of clobbering it: a
     single-config or --saturation run must not erase the other frozen
     sections (results merge by config name; saturation/perf_smoke
@@ -1318,6 +1524,8 @@ def _write_artifact(path, results=None, saturation=None, perf_smoke=None,
         doc["perf_smoke_write"] = perf_smoke_write
     if follower_fanout is not None:
         doc["follower_fanout"] = follower_fanout
+    if sockets is not None:
+        doc["sockets"] = sockets
     with open(path, "w") as f:
         json.dump(doc, f, indent=2)
 

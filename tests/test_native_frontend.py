@@ -1,0 +1,552 @@
+"""Native serving front-end tests (ISSUE 16): frame-fuzz parity with
+the Python decoder, whole-batch hit byte-parity, admission-shed parity,
+fault-site coverage on the native accept path, graceful fallback, and
+the SIGKILL-under-socket-storm chaos scenario.
+
+The contract under test: the C++ front-end (accept / framing / decode /
+admission / whole-batch cache hits off the GIL) is BEHAVIORALLY
+INDISTINGUISHABLE from the Python socketserver plane — same typed error
+replies for wrecked frames, same busy shapes with retry hints, same
+bytes for a cache hit at equal epoch ids — and its durability story is
+the WAL's, untouched: acked ⊆ recovered across a SIGKILL mid-storm.
+"""
+
+import json
+import os
+import random
+import selectors
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import msgpack
+import pytest
+
+from antidote_tpu import faults
+from antidote_tpu.api.node import AntidoteNode
+from antidote_tpu.config import AntidoteConfig
+from antidote_tpu.proto.client import AntidoteClient
+from antidote_tpu.proto.codec import (
+    MAX_FRAME,
+    MessageCode,
+    decode,
+    read_frame,
+)
+from antidote_tpu.proto.server import ProtocolServer
+
+_HDR = struct.Struct(">I")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.uninstall()
+
+
+def mk_cfg():
+    # same shapes as test_proto/test_overload: warm XLA compile cache
+    return AntidoteConfig(
+        n_shards=2, max_dcs=2, ops_per_key=8, snap_versions=2,
+        set_slots=8, rga_slots=16, keys_per_table=64, batch_buckets=(8, 64),
+    )
+
+
+def _boot(native: bool, **kw):
+    node = AntidoteNode(mk_cfg())
+    srv = ProtocolServer(node, port=0, native_frontend=native, **kw)
+    if native and srv.native is None:
+        srv.close()
+        pytest.skip("native frontend unavailable (no g++/epoll)")
+    return node, srv
+
+
+def _raw_frame(code: int, body) -> bytes:
+    payload = bytes([code]) + msgpack.packb(body, use_bin_type=True)
+    return _HDR.pack(len(payload)) + payload
+
+
+def _probe(port: int, raw: bytes, timeout: float = 10.0):
+    """Send raw bytes on a fresh conn, half-close, and report the
+    outcome: ("reply", frame) or ("closed", None).  Half-closing makes
+    the silent-drop cases deterministic on both planes — the server
+    sees EOF instead of waiting forever for the rest of a frame."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.settimeout(timeout)
+    try:
+        s.sendall(raw)
+        s.shutdown(socket.SHUT_WR)
+        try:
+            return ("reply", read_frame(s))
+        except (ConnectionError, OSError):
+            return ("closed", None)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# basic serving + observability
+# ---------------------------------------------------------------------------
+@pytest.mark.smoke
+def test_native_plane_serves_and_reports_stats():
+    node, srv = _boot(True)
+    c = AntidoteClient(port=srv.port)
+    try:
+        c.update_objects([("k", "counter_pn", "b", ("increment", 5))])
+        vals, clock = c.read_objects([("k", "counter_pn", "b")],
+                                     clock=None)
+        # clocked read-your-writes still holds through the native accept
+        vals2, _ = c.read_objects([("k", "counter_pn", "b")], clock=clock)
+        assert vals2 == [5]
+        st = srv.native.stats()
+        assert st["accepted"] >= 1
+        assert st["frames"] >= 3
+        assert srv._pipeline_status()["native"]["open_conns"] >= 1
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# whole-batch hit byte parity (acceptance: native replies byte-identical
+# to the Python serving path at equal epoch ids)
+# ---------------------------------------------------------------------------
+@pytest.mark.smoke
+def test_whole_batch_hit_bytes_match_python_path():
+    node, srv = _boot(True, epoch_tick_ms=25)
+    c = AntidoteClient(port=srv.port)
+    s = None
+    try:
+        c.update_objects([("pk", "counter_pn", "b", ("increment", 11))])
+        # let the serving epoch cover the write and the vc go quiescent:
+        # with no further commits, publish keeps re-advancing the SAME
+        # clock, so replies on either plane must be byte-identical
+        time.sleep(0.6)
+        req = _raw_frame(MessageCode.STATIC_READ_OBJECTS, {
+            "objects": [["pk", "counter_pn", "b"]], "clock": None,
+        })
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.settimeout(10)
+        replies = []
+        deadline = time.monotonic() + 20
+        hits0 = srv.native.stats()["native_hits"]
+        while srv.native.stats()["native_hits"] == hits0:
+            assert time.monotonic() < deadline, \
+                "native plane never served a whole-batch hit"
+            s.sendall(req)
+            replies.append(read_frame(s))
+        s.sendall(req)  # one more, definitely native-served
+        replies.append(read_frame(s))
+        # the first reply crossed to Python (cold mirror); the last was
+        # served by the C++ mirror — byte-identical, including the
+        # msgpack map layout and the commit clock
+        assert replies[-1] == replies[0], (
+            "native hit bytes diverge from the Python reply:\n"
+            f"  python: {replies[0]!r}\n  native: {replies[-1]!r}")
+        code, body = decode(replies[-1])
+        assert code == MessageCode.READ_OBJECTS_RESP
+        assert body["values"] == [11]
+    finally:
+        if s is not None:
+            s.close()
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# write invalidation: clockless reads through the native mirror are
+# bounded-stale and converge after every write
+# ---------------------------------------------------------------------------
+def test_native_mirror_invalidation_converges_and_never_overshoots():
+    node, srv = _boot(True, epoch_tick_ms=25)
+    c = AntidoteClient(port=srv.port)
+    try:
+        total = 0
+        for round_ in range(8):
+            total += 1
+            c.update_objects(
+                [("wk", "counter_pn", "b", ("increment", 1))])
+            deadline = time.monotonic() + 20
+            while True:
+                vals, _ = c.read_objects([("wk", "counter_pn", "b")],
+                                         clock=None)
+                # staleness is bounded by the epoch cadence; a value
+                # BEYOND the committed total would mean the mirror
+                # served bytes the store never published
+                assert vals[0] <= total, (round_, vals[0], total)
+                if vals[0] == total:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"clockless read stuck at {vals[0]} < {total}"
+                time.sleep(0.01)
+            # converged: the Python fill re-armed the mirror — repeat
+            # reads between writes are exactly what the fast path owns
+            for _ in range(4):
+                vals, _ = c.read_objects([("wk", "counter_pn", "b")],
+                                         clock=None)
+                assert vals == [total]
+        # the loop must have exercised the native fast path for real
+        assert srv.native.stats()["native_hits"] > 0
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# frame-fuzz parity: a seeded corpus of wrecked frames answered
+# IDENTICALLY by both accept planes (same typed error or same silent
+# close — the Python decoder's contract is the spec)
+# ---------------------------------------------------------------------------
+@pytest.mark.smoke
+def test_frame_fuzz_corpus_parity():
+    node_n, srv_n = _boot(True)
+    node_p, srv_p = _boot(False)
+    cp = AntidoteClient(port=srv_p.port)
+    cn = AntidoteClient(port=srv_n.port)
+    try:
+        for cli in (cn, cp):  # identical prefill on both nodes
+            cli.update_objects(
+                [("fz", "counter_pn", "b", ("increment", 3))])
+        time.sleep(0.4)
+        rng = random.Random(0xF00D)
+        corpus = []
+        # -- valid reads: served (value parity asserted below)
+        corpus.append(("valid-read", _raw_frame(
+            MessageCode.STATIC_READ_OBJECTS,
+            {"objects": [["fz", "counter_pn", "b"]], "clock": None})))
+        corpus.append(("valid-read-miss", _raw_frame(
+            MessageCode.STATIC_READ_OBJECTS,
+            {"objects": [["nope", "counter_pn", "b"]], "clock": None})))
+        # -- garbage msgpack bodies behind a valid header + code byte:
+        #    typed ERROR_RESP (decode exception name), conn kept
+        for i in range(6):
+            junk = bytes(rng.randrange(256) for _ in range(
+                rng.randrange(1, 40)))
+            payload = bytes([MessageCode.STATIC_READ_OBJECTS]) + junk
+            corpus.append((f"garbage-body-{i}",
+                           _HDR.pack(len(payload)) + payload))
+        # -- well-formed msgpack, wrong shape: typed ERROR_RESP too
+        corpus.append(("wrong-shape", _raw_frame(
+            MessageCode.STATIC_READ_OBJECTS, {"objects": 42})))
+        corpus.append(("unknown-code",
+                       _HDR.pack(2) + bytes([251]) + b"\xc0"))
+        # -- framing violations: the Python decoder drops the conn
+        #    silently (ConnectionError in read_frame_buffered) — the
+        #    native plane must mirror every one of these
+        corpus.append(("zero-length", _HDR.pack(0) + b"\x00"))
+        corpus.append(("oversized-length", _HDR.pack(MAX_FRAME + 1)))
+        corpus.append(("truncated-header", b"\x00\x00"))
+        corpus.append(("empty-conn", b""))
+        for i in range(4):
+            n = rng.randrange(8, 200)
+            sent = rng.randrange(0, n - 3)
+            corpus.append((f"mid-frame-close-{i}",
+                           _HDR.pack(n) + bytes(sent)))
+
+        mismatches = []
+        for name, raw in corpus:
+            out_n = _probe(srv_n.port, raw)
+            out_p = _probe(srv_p.port, raw)
+            if out_n[0] != out_p[0]:
+                mismatches.append((name, out_n[0], out_p[0]))
+                continue
+            if out_n[0] == "reply":
+                code_n, body_n = decode(out_n[1])
+                code_p, body_p = decode(out_p[1])
+                if code_n != code_p:
+                    mismatches.append((name, code_n, code_p))
+                elif code_n == MessageCode.ERROR_RESP:
+                    # typed errors must match byte-for-byte: same
+                    # exception name, same detail text, same layout
+                    if out_n[1] != out_p[1]:
+                        mismatches.append((name, body_n, body_p))
+                elif body_n.get("values") != body_p.get("values"):
+                    # served reads: value parity (clocks are per-node)
+                    mismatches.append(
+                        (name, body_n.get("values"), body_p.get("values")))
+        assert not mismatches, \
+            "native/python planes diverged on:\n" + "\n".join(
+                f"  {n}: native={a!r} python={b!r}"
+                for n, a, b in mismatches)
+    finally:
+        cn.close()
+        cp.close()
+        srv_n.close()
+        srv_p.close()
+
+
+# ---------------------------------------------------------------------------
+# admission-shed parity: both planes refuse with the SAME typed busy
+# reply — detail string and retry hint included (acceptance criterion)
+# ---------------------------------------------------------------------------
+@pytest.mark.smoke
+def test_admission_shed_busy_reply_parity():
+    caps = dict(max_in_flight=64, max_in_flight_per_client=1)
+
+    def shed_bytes(node, srv, in_flight):
+        """Wedge the commit plane, park one admitted update, and
+        capture the raw busy frame a second same-host conn receives."""
+        a = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        a.settimeout(30)
+        b = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        b.settimeout(10)
+        try:
+            with node.txm.commit_lock:
+                a.sendall(_raw_frame(MessageCode.STATIC_UPDATE_OBJECTS, {
+                    "updates": [["sk", "counter_pn", "b",
+                                 ["increment", 1]]],
+                    "clock": None,
+                }))
+                deadline = time.monotonic() + 20
+                while in_flight() < 1:  # a admitted + parked on commit
+                    assert time.monotonic() < deadline
+                    time.sleep(0.005)
+                # same host, cold key (no fast-path hit): per-client cap
+                b.sendall(_raw_frame(MessageCode.STATIC_READ_OBJECTS, {
+                    "objects": [["cold", "counter_pn", "b"]],
+                    "clock": None,
+                }))
+                busy = read_frame(b)
+            ack = read_frame(a)  # the parked update completed
+            code, body = decode(ack)
+            assert "commit_clock" in body, body
+            return busy
+        finally:
+            a.close()
+            b.close()
+
+    node_n, srv_n = _boot(True, **caps)
+    try:
+        busy_n = shed_bytes(node_n, srv_n,
+                            lambda: srv_n.native.stats()["in_flight"])
+        assert srv_n.native.stats()["sheds"] >= 1
+    finally:
+        srv_n.close()
+    node_p, srv_p = _boot(False, **caps)
+    try:
+        busy_p = shed_bytes(node_p, srv_p, srv_p.admission.in_flight)
+    finally:
+        srv_p.close()
+
+    # the C++ admission layer mirrors overload.py exactly: same error
+    # kind, same human-readable detail, same pressure-scaled hint —
+    # byte-for-byte, so client backoff logic cannot tell the planes apart
+    assert busy_n == busy_p, (busy_n, busy_p)
+    code, body = decode(busy_n)
+    assert code == MessageCode.ERROR_RESP
+    assert body["error"] == "busy"
+    assert body["detail"] == \
+        "client 127.0.0.1 at max_in_flight_per_client=1"
+    assert body["retry_after_ms"] >= 25
+
+
+# ---------------------------------------------------------------------------
+# fallback + fault sites on the native path
+# ---------------------------------------------------------------------------
+@pytest.mark.smoke
+def test_env_kill_switch_falls_back_to_python_plane(monkeypatch):
+    monkeypatch.setenv("ANTIDOTE_NATIVE_FRONTEND", "off")
+    node = AntidoteNode(mk_cfg())
+    srv = ProtocolServer(node, port=0, native_frontend=True)
+    c = AntidoteClient(port=srv.port)
+    try:
+        assert srv.native is None  # the advertised port is socketserver's
+        c.update_objects([("e", "counter_pn", "b", ("increment", 2))])
+        vals, _ = c.read_objects([("e", "counter_pn", "b")])
+        assert vals == [2]
+        assert "native" not in srv._pipeline_status()
+    finally:
+        c.close()
+        srv.close()
+
+
+@pytest.mark.smoke
+def test_injected_load_failure_falls_back_and_counts():
+    from antidote_tpu.obs.metrics import net_metrics
+
+    plan = faults.FaultPlan(seed=3)
+    plan.error("native_frontend.load")
+    faults.install(plan)
+    before = net_metrics().frontend_fallback.value()
+    node = AntidoteNode(mk_cfg())
+    srv = ProtocolServer(node, port=0, native_frontend=True)
+    c = AntidoteClient(port=srv.port)
+    try:
+        assert srv.native is None
+        assert net_metrics().frontend_fallback.value() == before + 1
+        c.update_objects([("f", "counter_pn", "b", ("increment", 1))])
+        vals, _ = c.read_objects([("f", "counter_pn", "b")])
+        assert vals == [1]
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_frontend_recv_faults_fire_on_native_path():
+    """frontend.recv drop/truncate rules are applied per drained frame
+    on the native plane too — and an armed frontend.* rule disables
+    fast-serve at boot, so NO frame can dodge the plan via a C++ hit."""
+    plan = faults.FaultPlan(seed=11)
+    plan.drop("frontend.recv", times=1)
+    plan.truncate("frontend.recv", times=1, keep=5)
+    inj = faults.install(plan)
+    node, srv = _boot(True)
+    try:
+        req = _raw_frame(MessageCode.STATIC_READ_OBJECTS, {
+            "objects": [["k", "counter_pn", "b"]], "clock": None,
+        })
+        # rule 1 (drop): the frame vanishes and the conn is closed —
+        # the client sees EOF, never a hung socket
+        out = _probe(srv.port, req)
+        assert out[0] == "closed", out
+        # rule 2 (truncate to 5 bytes): the mangled frame decodes to a
+        # typed ERROR_RESP, exactly like the Python plane's twin site
+        out = _probe(srv.port, req)
+        assert out[0] == "reply", out
+        code, body = decode(out[1])
+        assert code == MessageCode.ERROR_RESP, body
+        # rules exhausted: the plane serves normally again
+        out = _probe(srv.port, req)
+        assert out[0] == "reply" and \
+            decode(out[1])[0] == MessageCode.READ_OBJECTS_RESP
+        assert inj.fired("frontend.recv") == 2
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: SIGKILL under a >=1k-socket storm with seeded
+# drop/truncate faults on the native accept path — every ack made it to
+# the WAL (acked ⊆ recovered), and no connection wedges
+# ---------------------------------------------------------------------------
+def test_sigkill_under_socket_storm_acked_subset_recovered(tmp_path):
+    n_socks = 1024
+    n_keys = 128  # sockets share keys: per-key acked sums stay testable
+    log_dir = str(tmp_path / "wal")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # seeded frame wreckage on the accept path for the whole run:
+        # drops close conns mid-storm, truncates produce typed errors
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 23, "rules": [
+            {"site": "frontend.recv", "action": "drop", "p": 0.002,
+             "times": 64},
+            {"site": "frontend.recv", "action": "truncate", "p": 0.002,
+             "times": 64, "arg": 6},
+        ]}),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", "2", "--max-dcs", "2",
+         "--keys-per-table", "1024", "--log-dir", log_dir, "--sync-log",
+         "--wal-segments", "3", "--max-connections", str(n_socks + 64),
+         "--max-in-flight-per-client", "512"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True,
+    )
+    acked = [0] * n_keys
+    attempted = [0] * n_keys
+    socks = []
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        port = info["port"]
+
+        def upd_frame(key_i):
+            return _raw_frame(MessageCode.STATIC_UPDATE_OBJECTS, {
+                "updates": [[f"s{key_i}", "counter_pn", "b",
+                             ["increment", 1]]],
+                "clock": None,
+            })
+
+        sel = selectors.DefaultSelector()
+        deadline = time.monotonic() + 60
+        for i in range(n_socks):
+            s = socket.create_connection(("127.0.0.1", port), timeout=30)
+            s.settimeout(None)
+            socks.append(s)
+            key_i = i % n_keys
+            # state: [rxbuf, key_i, live]
+            sel.register(s, selectors.EVENT_READ, [bytearray(), key_i, True])
+            attempted[key_i] += 1
+            s.sendall(upd_frame(key_i))
+            assert time.monotonic() < deadline, \
+                f"storm connect stalled at {i} sockets"
+
+        # closed-loop storm: each ack (commit_clock reply) immediately
+        # launches the next increment on that socket; busy sheds and
+        # typed errors relaunch too (refused work was NOT applied)
+        t_end = time.monotonic() + 6.0
+        while time.monotonic() < t_end and sum(acked) < 4000:
+            for sk, _ in sel.select(timeout=0.2):
+                st = sk.data
+                try:
+                    data = sk.fileobj.recv(1 << 16)
+                except OSError:
+                    data = b""
+                if not data:  # fault-dropped conn: dead, not wedged
+                    sel.unregister(sk.fileobj)
+                    st[2] = False
+                    continue
+                st[0] += data
+                while len(st[0]) >= 4:
+                    (n,) = _HDR.unpack(st[0][:4])
+                    if len(st[0]) < 4 + n:
+                        break
+                    frame = bytes(st[0][4:4 + n])
+                    del st[0][:4 + n]
+                    code, body = decode(frame)
+                    if code != MessageCode.ERROR_RESP:
+                        assert "commit_clock" in body, body
+                        acked[st[1]] += 1
+                    attempted[st[1]] += 1
+                    try:
+                        sk.fileobj.sendall(upd_frame(st[1]))
+                    except OSError:
+                        sel.unregister(sk.fileobj)
+                        st[2] = False
+                        break
+        assert sum(acked) >= 500, \
+            f"storm never reached real throughput: {sum(acked)} acks"
+        proc.send_signal(signal.SIGKILL)  # mid-storm, no goodbyes
+        proc.wait(timeout=10)
+        # no wedged conns: the kill severs EVERY remaining socket — each
+        # one must observe EOF/reset promptly, none parks forever
+        eof_deadline = time.monotonic() + 15
+        live = [s for s in socks if not s._closed]
+        for s in live:
+            s.settimeout(max(0.1, eof_deadline - time.monotonic()))
+            try:
+                while s.recv(1 << 16):
+                    pass
+            except socket.timeout:
+                pytest.fail("a connection wedged past the server's death")
+            except (ConnectionError, OSError):
+                pass  # reset counts as closed, same as EOF
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # recover twice, independently — acked ⊆ recovered ⊆ attempted per
+    # key, and both recoveries are byte-identical (the WAL contract is
+    # untouched by WHICH plane accepted the bytes)
+    rcfg = AntidoteConfig(n_shards=2, max_dcs=2, keys_per_table=1024,
+                          wal_segments=3)
+    objs = [(f"s{i}", "counter_pn", "b") for i in range(n_keys)]
+    recovered = []
+    for _ in range(2):
+        node = AntidoteNode(rcfg, log_dir=log_dir, recover=True)
+        vals, _ = node.read_objects(objs)
+        recovered.append(vals)
+        node.store.log.close()
+    assert recovered[0] == recovered[1], "recoveries diverged"
+    for i in range(n_keys):
+        assert acked[i] <= recovered[0][i] <= attempted[i], (
+            f"s{i}: acked={acked[i]} recovered={recovered[0][i]} "
+            f"attempted={attempted[i]}")

@@ -125,6 +125,7 @@ def check_file(path: str):
     _check_reclaim_policy(path, lines, problems)
     _check_epoch_stamp(path, lines, problems)
     _check_evict_policy(path, lines, problems)
+    _check_py_socket(path, lines, problems)
     return problems
 
 
@@ -396,6 +397,41 @@ def _check_evict_policy(path, lines, problems) -> None:
                 "the cold tier — route it through store/coldtier.py's "
                 "guarded evict (verified sidecar coverage), or justify "
                 "with '# evict-ok: <reason>'"
+            )
+
+
+#: the serving front-end's socket I/O belongs to the native plane
+#: (proto/cpp/frontend.cc — accept, framing, hot decode, whole-batch
+#: hits all off the GIL, ISSUE 16).  A raw ``.recv(`` / ``.sendall(``
+#: creeping back into server.py's hot stages quietly re-serializes the
+#: serving path behind the GIL; the surviving Python sites (the
+#: socketserver fallback plane) must say which plane they are with a
+#: ``# py-socket-ok: <reason>`` note.
+_PY_SOCKET_FILE = os.path.join("antidote_tpu", "proto", "server.py")
+
+
+def _check_py_socket(path, lines, problems) -> None:
+    """Reject raw ``.recv(`` / ``.sendall(`` in proto/server.py without
+    a ``# py-socket-ok: <reason>`` annotation on the line or within the
+    three preceding lines — socket I/O on the serving path lives in the
+    native front-end; Python-plane sites carry written justification."""
+    norm = os.path.normpath(path)
+    if not norm.endswith(_PY_SOCKET_FILE):
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("py-socket-ok:" in ln for ln in lines[lo:lineno])
+
+    for i, ln in enumerate(lines, start=1):
+        code = ln.split("#", 1)[0]
+        if (".recv(" in code or ".sendall(" in code) \
+                and not annotated(i) and "py-socket-ok:" not in ln:
+            problems.append(
+                f"{path}:{i}: raw socket I/O in the serving front-end "
+                "— the native plane (proto/cpp/frontend.cc) owns "
+                "accept/framing/replies; a Python-plane site must "
+                "justify with '# py-socket-ok: <reason>'"
             )
 
 
