@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke proxy-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -98,6 +98,17 @@ replica-smoke:
 fleet-smoke:
 	$(PY) -m pytest tests/test_session_fabric.py -q
 	$(PY) bench_wire.py --fleet-smoke --assert-bounds
+
+# symmetric serving fabric (ISSUE 17): the proxy/forward/fleet-health
+# suite plus one live run of ring-OBLIVIOUS clients through ONE entry
+# follower — writes forward to the owner, foreign-arc reads proxy one
+# hop, own-arc reads serve locally.  The gate is STRUCTURAL only: zero
+# surfaced typed redirects, zero session violations, nonzero forwarded
+# read AND write traffic; the frozen proxy_fanout hop-cost point in
+# BENCH_WIRE_cluster_cpu.json is never a throughput ratchet
+proxy-smoke:
+	$(PY) -m pytest tests/test_proxy.py -q
+	$(PY) bench_wire.py --proxy-fanout --smoke --assert-bounds
 
 # mesh serving plane (ISSUE 10): the deterministic mesh suite on the
 # forced 8-device CPU mesh (read parity byte-identical with the

@@ -251,6 +251,7 @@ def cmd_serve(args) -> int:
             group_commit_window_us=args.group_commit_window_us,
             follower=follower,
             native_frontend=args.native_frontend,
+            server_proxy=not args.no_server_proxy,
         )
         return server_box["srv"]
 
@@ -622,6 +623,12 @@ def main(argv=None) -> int:
                     help="how long a session read parks for the applied "
                          "clock to catch its token before the typed "
                          "lagging redirect")
+    sv.add_argument("--no-server-proxy", action="store_true",
+                    help="disable the symmetric serving fabric on this "
+                         "follower: out-of-arc reads and writes answer "
+                         "typed lagging/not_owner redirects instead of "
+                         "being proxied/forwarded to the arc owner "
+                         "(the pre-fabric smart-client-only behavior)")
     sv.add_argument("--divergence-check-s", type=float, default=5.0,
                     help="cadence of the follower's round-robin per-shard "
                          "digest comparison against the owner (detects "

@@ -138,6 +138,12 @@ class FollowerReplica(DCReplica):
         self._last_report = 0.0
         self._last_digest = time.monotonic()
         self._digest_rr = 0
+        #: serving-fleet snapshot learned from follower_report replies
+        #: (ISSUE 17): name -> {addr, state}, the feed of the proxy
+        #: plane's FleetHealth table.  The version counter lets the
+        #: plane rebuild its ring only when a new snapshot landed.
+        self.fleet_table: Dict[str, dict] = {}
+        self.fleet_table_v = 0
 
     # -- identity overrides ---------------------------------------------
     def _ingest_own_origin(self) -> bool:
@@ -1114,9 +1120,17 @@ class FollowerReplica(DCReplica):
         fids = self.member_fids or [self.owner_fid]
         for fid in fids:
             try:
-                self.hub.request(fid, "follower_report", body)
+                reply = self.hub.request(fid, "follower_report", body)
             except Exception:
                 failed += 1
+                continue
+            # the owner piggybacks its registry's serving-fleet snapshot
+            # on the report ACK (ISSUE 17) — the proxy plane's health
+            # table learns membership with zero extra round trips
+            fleet = (reply or {}).get("fleet")
+            if fleet is not None and fleet != self.fleet_table:
+                self.fleet_table = fleet
+                self.fleet_table_v += 1
         if failed == len(fids):
             # the whole owner DC is unreachable (partition / restart):
             # the subscription reconnect machinery owns the healing; the

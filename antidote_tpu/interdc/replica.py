@@ -858,8 +858,18 @@ class DCReplica:
             lag = max(0, int(self.node.txm.commit_counter)
                       - int(ent["applied"][self.dc_id]))
             m.follower_lag.set(lag, follower=name)
+        # piggyback the registry's serving-fleet snapshot on the ACK
+        # (ISSUE 17): every follower learns membership + typed states
+        # from the report round trip it already makes, feeding its
+        # server-side proxy plane's health table.  Computed OUTSIDE the
+        # followers lock (replica_status takes it; it is not reentrant).
+        fleet = {
+            fname: {"addr": fent.get("addr"), "state": fent["state"]}
+            for fname, fent in self.replica_status()["followers"].items()
+        }
         return {"accepted": True,
-                "commit_counter": int(self.node.txm.commit_counter)}
+                "commit_counter": int(self.node.txm.commit_counter),
+                "fleet": fleet}
 
     def replica_status(self) -> dict:
         """The node-status / console ``replica status`` block: every

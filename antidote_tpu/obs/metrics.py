@@ -656,6 +656,31 @@ class NodeMetrics:
             "Followers currently registered with this owner's replica "
             "registry (the fleet the hash ring routes over)",
         )
+        # symmetric serving fabric (ISSUE 17): server-side proxying /
+        # forwarding volume by kind (read | write | txn) and outcome
+        # (ok | failover = served after >=1 dead hop | error), the
+        # per-hop proxy latency, and the node's local fleet-health view
+        self.proxy_total = r.counter(
+            "antidote_proxy_total",
+            "Requests this node proxied/forwarded to another fleet "
+            "member (kind: read | write | txn; outcome: ok | failover "
+            "| error)",
+            ("kind", "outcome"),
+        )
+        self.proxy_hop_seconds = r.histogram(
+            "antidote_proxy_hop_seconds",
+            "Wall time of one server-side proxy/forward hop, dial to "
+            "decoded reply (s)",
+            buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                     2.5e-2, 5e-2, 0.1, 0.5, 1, 5),
+        )
+        self.fleet_health = r.gauge(
+            "antidote_fleet_health",
+            "This node's live view of each fleet endpoint (1 = "
+            "serving, 0 = dead/down — registry state merged with local "
+            "connect/timeout observations)",
+            label_names=("endpoint",),
+        )
         self.follower_bootstrap = r.counter(
             "antidote_follower_bootstrap_total",
             "Follower bootstrap/repair cycles by mode (image = full "

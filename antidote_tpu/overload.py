@@ -106,6 +106,23 @@ class ReplicaDown(ConnectionError):
     client's failover loop."""
 
 
+class ForwardFailed(Exception):
+    """A server-side forwarded write (ISSUE 17) lost its owner
+    connection AFTER the request left the socket: the owner **may have
+    executed** the non-idempotent commit, so the forwarding node must
+    not blindly resend — it surfaces this typed error and the CLIENT
+    decides (re-read at its session token, or retry an idempotent op).
+    Send-phase failures never raise this: they redial within the
+    forwarding budget, exactly the at-most-once ``request_sent``
+    discipline the session client and the inter-DC query channel keep."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        #: the defining property: the forwarded request reached the
+        #: wire, so the owner may have executed it
+        self.maybe_executed = True
+
+
 def retry_hint_ms(streak: int) -> int:
     """Pressure-scaled retry hint shared by every refusal plane: the
     streak counts refusals since the plane last admitted work, so it
@@ -205,5 +222,5 @@ class AdmissionGate:
 
 __all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
            "NotOwnerError", "ReplicaLagging", "ReplicaDown", "ColdMiss",
-           "AdmissionGate", "deadline_from_ms", "check_deadline",
-           "retry_hint_ms"]
+           "ForwardFailed", "AdmissionGate", "deadline_from_ms",
+           "check_deadline", "retry_hint_ms"]

@@ -146,7 +146,12 @@ SCHEMAS: Dict[str, list] = {
                       (2, "commit_time", "optional", "bytes"),
                       (3, "errorcode", "optional", "uint32")],
     "ApbStaticReadObjectsResp": [(1, "objects", "required", "ApbReadObjectsResp"),
-                                 (2, "committime", "required", "ApbCommitResp")],
+                                 (2, "committime", "required", "ApbCommitResp"),
+                                 # ring-hint extension (ISSUE 17): msgpack
+                                 # {owner, followers, vnodes} attached to
+                                 # PROXIED replies; proto2 decoders that
+                                 # predate it skip the unknown field
+                                 (3, "ring_hint", "optional", "bytes")],
 }
 
 #: message code byte (antidote_pb_codec's messageCodes table)
@@ -505,19 +510,24 @@ def _error(msg: str) -> bytes:
 
 
 def error_text(kind: str, msg: str, retry_after_ms: int = 0,
-               redirect=None) -> str:
+               redirect=None, fleet=None) -> str:
     """Typed error text: proto2 ApbErrorResp has no structured retry or
     redirect field, so the kind + retry-after hint + owner redirect ride
     the errmsg prefix (``"lagging retry_after_ms=NN
     redirect=HOST:PORT: ..."``), which antidotec_pb clients surface
     verbatim and session-aware ones parse back with
     :func:`parse_error_text` — the apb twin of the native dialect's
-    structured error fields (ISSUE 11)."""
+    structured error fields (ISSUE 11).  ``fleet`` (a list of follower
+    endpoints) is the errmsg-encoded ring hint (ISSUE 17): space-free
+    ``fleet=H:P,H:P`` so the existing param grammar carries it."""
     out = kind
     if retry_after_ms:
         out += f" retry_after_ms={int(retry_after_ms)}"
     if redirect:
         out += f" redirect={redirect[0]}:{int(redirect[1])}"
+    if fleet:
+        out += " fleet=" + ",".join(
+            f"{h}:{int(p)}" for h, p in fleet)
     return f"{out}: {msg}"
 
 
@@ -539,7 +549,8 @@ def parse_error_text(errmsg) -> Dict[str, Any]:
                 "detail": text}
     kind, params, detail = m.group(1), m.group(2), m.group(3)
     out: Dict[str, Any] = {"kind": kind, "retry_after_ms": 0,
-                           "redirect": None, "detail": detail}
+                           "redirect": None, "fleet": None,
+                           "detail": detail}
     for part in params.split():
         k, _, v = part.partition("=")
         # a malformed value (a foreign server whose errmsg happens to
@@ -556,6 +567,17 @@ def parse_error_text(errmsg) -> Dict[str, Any]:
                 out["redirect"] = [host, int(port)]
             except ValueError:
                 pass
+        elif k == "fleet":
+            eps = []
+            for item in v.split(","):
+                host, _, port = item.rpartition(":")
+                try:
+                    eps.append([host, int(port)])
+                except ValueError:
+                    eps = None
+                    break
+            if eps:
+                out["fleet"] = eps
     return out
 
 
@@ -564,14 +586,34 @@ def overload_error(kind: str, msg: str, retry_after_ms: int = 0) -> bytes:
     return _error(error_text(kind, msg, retry_after_ms))
 
 
-def _error_resp(e) -> Tuple[str, Dict[str, Any]]:
+def _fleet_hint(server):
+    """Errmsg ring-hint endpoints (ISSUE 17) for a follower's typed
+    redirect: the owner first, then the live fleet — space-free
+    ``H:P`` pairs for :func:`error_text`'s ``fleet=`` param."""
+    plane = getattr(server, "proxy", None) if server is not None else None
+    if plane is None:
+        return None
+    hint = plane.ring_hint()
+    if hint is None:
+        return None
+    # FOLLOWERS only: the owner already rides the structured
+    # ``redirect=`` param, and conflating the two would teach a session
+    # client to put the owner on its read ring
+    return hint.get("followers") or None
+
+
+def _error_resp(e, server=None) -> Tuple[str, Dict[str, Any]]:
     """Map one exception to the typed ApbErrorResp reply — overload
     sheds, follower session redirects (lagging/not_owner, carrying the
-    retry hint + owner redirect in the errmsg), and the reference's
-    catch-all shape for everything else."""
+    retry hint + owner redirect in the errmsg), forwarding failures
+    (``forward_failed``: the owner may have executed), and the
+    reference's catch-all shape for everything else.  ``server`` (when
+    given and fronting a follower) lets redirect-class errors carry the
+    errmsg-encoded fleet hint."""
     from antidote_tpu.overload import (BusyError, ColdMiss,
-                                       DeadlineExceeded, NotOwnerError,
-                                       ReadOnlyError, ReplicaLagging)
+                                       DeadlineExceeded, ForwardFailed,
+                                       NotOwnerError, ReadOnlyError,
+                                       ReplicaLagging)
 
     if isinstance(e, BusyError):
         text = error_text("busy", str(e), e.retry_after_ms)
@@ -583,9 +625,13 @@ def _error_resp(e) -> Tuple[str, Dict[str, Any]]:
         text = error_text("read_only", str(e))
     elif isinstance(e, ReplicaLagging):
         text = error_text("lagging", str(e), e.retry_after_ms,
-                          e.redirect)
+                          e.redirect, fleet=_fleet_hint(server))
     elif isinstance(e, NotOwnerError):
-        text = error_text("not_owner", str(e), redirect=e.redirect)
+        text = error_text("not_owner", str(e), redirect=e.redirect,
+                          fleet=_fleet_hint(server))
+    elif isinstance(e, ForwardFailed):
+        text = error_text("forward_failed", str(e),
+                          fleet=_fleet_hint(server))
     else:
         text = f"{type(e).__name__}: {e}"
     return "ApbErrorResp", {"errmsg": to_bytes(text), "errcode": 0}
@@ -594,11 +640,24 @@ def _error_resp(e) -> Tuple[str, Dict[str, Any]]:
 #: apb requests a FOLLOWER refuses with a typed not_owner redirect:
 #: writes and interactive transactions belong to the owner, and the DC
 #: mesh mutations would subscribe the follower to streams the owner
-#: never replicated (the native dialect's exact refusal set)
+#: never replicated (the native dialect's exact refusal set).  With a
+#: proxy plane attached (ISSUE 17) only the DC-mesh mutations still
+#: refuse — everything else forwards to the owner write plane.
 FOLLOWER_REFUSED = frozenset((
     "ApbStartTransaction", "ApbReadObjects", "ApbUpdateObjects",
     "ApbCommitTransaction", "ApbStaticUpdateObjects",
     "ApbConnectToDCs", "ApbCreateDC",
+))
+
+#: apb requests a follower FORWARDS to the owner over the proxy plane
+#: (satellite 1, ISSUE 17): the refusal set minus the DC-mesh mutations
+#: (which stay refused — forwarding them would silently mutate the
+#: owner's mesh), plus abort (finishing a forwarded txn must reach the
+#: owner that holds it)
+FOLLOWER_FORWARDED = frozenset((
+    "ApbStartTransaction", "ApbReadObjects", "ApbUpdateObjects",
+    "ApbCommitTransaction", "ApbAbortTransaction",
+    "ApbStaticUpdateObjects",
 ))
 
 
@@ -623,17 +682,28 @@ def handle_request(server, code: int, payload: bytes, conn_txns: set,
 
     name = CODE_TO_NAME[code]
     fol = getattr(server, "follower", None)
-    if fol is not None and name in FOLLOWER_REFUSED:
+    plane = getattr(server, "proxy", None)
+    if fol is not None and name in FOLLOWER_REFUSED and (
+            plane is None or name not in FOLLOWER_FORWARDED):
         from antidote_tpu.overload import NotOwnerError
 
         server.metrics.session_redirects.inc(kind="not_owner",
                                              dialect="apb")
         return encode_frame_body(
-            *_error_resp(NotOwnerError(fol.owner_client_addr)))
+            *_error_resp(NotOwnerError(fol.owner_client_addr),
+                         server=server))
     try:
         req = decode_msg(name, payload)  # outside the lock
     except Exception as e:
         return _error(f"{type(e).__name__}: {e}")
+    if (fol is not None and plane is not None
+            and name in FOLLOWER_FORWARDED):
+        # satellite 1 (ISSUE 17): apb writes/txns at a follower ride the
+        # server-side forwarding plane instead of bouncing a typed
+        # not_owner — the typed errors come back only when forwarding is
+        # exhausted (errmsg-encoded by _error_resp, with the fleet hint)
+        return encode_frame_body(
+            *_forward_apb(server, plane, name, req, conn_txns))
     if name in ("ApbStaticReadObjects", "ApbStaticUpdateObjects"):
         # static ops ride the server's gate helpers (batched: the gate's
         # dispatcher thread takes the lock; unbatched: they lock inline)
@@ -644,6 +714,91 @@ def handle_request(server, code: int, payload: bytes, conn_txns: set,
     with (lock if lock is not None else contextlib.nullcontext()):
         resp_name, resp = _dispatch(server, name, req, conn_txns)
     return encode_frame_body(resp_name, resp)  # outside the lock
+
+
+def _forward_apb(server, plane, name: str, req: Dict[str, Any],
+                 conn_txns: set) -> Tuple[str, Dict[str, Any]]:
+    """Forward one apb write/txn request from a follower to the owner
+    write plane (satellite 1, ISSUE 17).  The request is decoded once
+    here, relayed over the plane's native channels, and the owner's
+    reply re-encoded apb — so both dialects share one failover loop,
+    one at-most-once discipline, and one ``proxy.forward`` fault site."""
+    from antidote_tpu.overload import BusyError, deadline_from_ms
+    from antidote_tpu.proto.codec import MessageCode, decode_value
+
+    node = server.node
+    my_dc = getattr(node, "dc_id", 0)
+    deadline = deadline_from_ms(None, server.default_deadline_ms)
+    try:
+        if name == "ApbStaticUpdateObjects":
+            clock = _dec_clock(req["transaction"].get("timestamp"))
+            vc = plane.forward_update(
+                updates_from_update_ops(req.get("updates", []), my_dc),
+                clock, deadline)
+            return "ApbCommitResp", {
+                "success": True, "commit_time": _enc_clock(vc),
+            }
+        if name == "ApbStartTransaction":
+            resp = plane.txn_call(MessageCode.START_TRANSACTION, {
+                "clock": _dec_clock(req.get("timestamp")),
+            })
+            txid = resp["txid"]
+            plane.forwarded_txns.add(txid)
+            conn_txns.add(txid)
+            return "ApbStartTransactionResp", {
+                "success": True,
+                "transaction_descriptor": str(txid).encode(),
+            }
+        txid = int(req["transaction_descriptor"])
+        if name == "ApbReadObjects":
+            objs = [_bound_object(bo) for bo in req["boundobjects"]]
+            resp = plane.txn_call(MessageCode.READ_OBJECTS, {
+                "txid": txid, "objects": [list(o) for o in objs],
+            })
+            vals = [decode_value(v) for v in resp["values"]]
+            return "ApbReadObjectsResp", {
+                "success": True,
+                "objects": [
+                    value_to_read_resp(t, v)
+                    for (_, t, _), v in zip(objs, vals)
+                ],
+            }
+        if name == "ApbUpdateObjects":
+            ups = updates_from_update_ops(req["updates"], my_dc)
+            try:
+                plane.txn_call(MessageCode.UPDATE_OBJECTS, {
+                    "txid": txid, "updates": [list(u) for u in ups],
+                })
+            except Exception:
+                # the owner aborted + unregistered the txn (its update
+                # failure discipline) — drop the forwarded bookkeeping
+                plane.forwarded_txns.discard(txid)
+                conn_txns.discard(txid)
+                raise
+            return "ApbOperationResp", {"success": True}
+        if name == "ApbCommitTransaction":
+            try:
+                resp = plane.txn_call(MessageCode.COMMIT_TRANSACTION,
+                                      {"txid": txid})
+            except BusyError:
+                raise  # txn stays OPEN at the owner — retryable
+            except Exception:
+                plane.forwarded_txns.discard(txid)
+                conn_txns.discard(txid)
+                raise
+            plane.forwarded_txns.discard(txid)
+            conn_txns.discard(txid)
+            return "ApbCommitResp", {
+                "success": True,
+                "commit_time": _enc_clock(resp["commit_clock"]),
+            }
+        # ApbAbortTransaction
+        plane.txn_call(MessageCode.ABORT_TRANSACTION, {"txid": txid})
+        plane.forwarded_txns.discard(txid)
+        conn_txns.discard(txid)
+        return "ApbOperationResp", {"success": True}
+    except Exception as e:
+        return _error_resp(e, server=server)
 
 
 def _dispatch_static(server, name: str, req: Dict[str, Any]):
@@ -668,17 +823,18 @@ def _dispatch_static(server, name: str, req: Dict[str, Any]):
         clock = _dec_clock(req["transaction"].get("timestamp"))
         objs = [_bound_object(bo) for bo in req.get("objects", [])]
         fol = getattr(server, "follower", None)
+        via_proxy = False
         if fol is not None:
-            # the token gate — byte-for-byte the native dialect's
-            # session discipline: park for the applied clocks, then a
-            # typed lagging redirect (errmsg-encoded by _error_resp)
-            fol.gate_read(
-                objs,
-                None if clock is None else np.asarray(clock, np.int64),
-                deadline, dialect="apb",
-            )
-        vals, vc = server.static_read(objs, clock, deadline=deadline)
-        return "ApbStaticReadObjectsResp", {
+            # the session token gate + serving-fabric routing (ISSUE
+            # 17): in-arc keys serve locally behind the applied-clock
+            # gate, out-of-arc keys proxy one hop to the arc owner —
+            # byte-for-byte the native dialect's discipline (typed
+            # lagging only as the last resort, errmsg-encoded)
+            (vals, vc), via_proxy = server._follower_read(
+                objs, clock, deadline, dialect="apb")
+        else:
+            vals, vc = server.static_read(objs, clock, deadline=deadline)
+        resp = {
             "objects": {
                 "success": True,
                 "objects": [
@@ -688,8 +844,17 @@ def _dispatch_static(server, name: str, req: Dict[str, Any]):
             },
             "committime": {"success": True, "commit_time": _enc_clock(vc)},
         }
+        if via_proxy:
+            # teach capable clients the ring so they converge back to
+            # zero-hop (proto2-safe: unknown optional field, skipped by
+            # decoders that predate it)
+            plane = getattr(server, "proxy", None)
+            hint = plane.ring_hint() if plane is not None else None
+            if hint is not None:
+                resp["ring_hint"] = msgpack.packb(hint)
+        return "ApbStaticReadObjectsResp", resp
     except Exception as e:
-        return _error_resp(e)
+        return _error_resp(e, server=server)
 
 
 def _dispatch(server, name: str, req: Dict[str, Any],

@@ -76,6 +76,18 @@ class RemoteLagging(RemoteError):
         self.redirect = redirect
 
 
+class RemoteForwardFailed(RemoteError):
+    """A follower forwarding this write/txn op to the owner (ISSUE 17)
+    lost the owner connection AFTER the request left its socket: the
+    owner **may have executed** it, and the at-most-once contract
+    forbids a blind resend.  Re-read at the session token to learn the
+    outcome (or retry only if the op is idempotent)."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.maybe_executed = True
+
+
 class RemoteColdMiss(RemoteError):
     """A cold-tier key's fault-in was refused (rate cap, I/O fault, or
     sidecar CRC failure): the read/write was NOT served — retry after
@@ -127,6 +139,10 @@ class AntidoteClient:
         # generator in bench_wire, where its CPU bills against the server
         self._rfile = self._sock.makefile("rb")
         self._packer = msgpack.Packer(use_bin_type=True)
+        #: last ring hint a follower attached to a reply (ISSUE 17):
+        #: ``{owner, followers, vnodes}`` — consumed (and cleared) by
+        #: SessionClient to refresh its fleet in place
+        self.ring_hint: Optional[dict] = None
 
     # ------------------------------------------------------------------
     def _call(self, code: MessageCode, body: Any):
@@ -146,6 +162,8 @@ class AntidoteClient:
             except (ConnectionError, OSError) as e:
                 e.request_sent = True
                 raise
+        if isinstance(resp, dict) and resp.get("ring_hint") is not None:
+            self.ring_hint = resp["ring_hint"]
         if resp_code == MessageCode.ERROR_RESP:
             err = resp.get("error")
             if err == "aborted":
@@ -169,6 +187,8 @@ class AntidoteClient:
                                      int(resp.get("retry_after_ms", 50)),
                                      permanent=bool(
                                          resp.get("permanent")))
+            if err == "forward_failed":
+                raise RemoteForwardFailed(resp.get("detail", ""))
             raise RemoteError(f"{err}: {resp.get('detail')}")
         return resp
 
@@ -183,7 +203,8 @@ class AntidoteClient:
 
     def update_objects(self, updates: Sequence[Tuple],
                        clock: Optional[Sequence[int]] = None,
-                       deadline_ms: Optional[float] = None) -> List[int]:
+                       deadline_ms: Optional[float] = None,
+                       proxied: bool = False) -> List[int]:
         req = {
             "updates": list(updates),
             "clock": None if clock is None else [int(x) for x in clock],
@@ -192,18 +213,27 @@ class AntidoteClient:
             # relative budget; the server aborts the request at dequeue
             # once it has outlived this (RemoteDeadline reply)
             req["deadline_ms"] = float(deadline_ms)
+        if proxied:
+            # no-reforward flag (ISSUE 17): this request already crossed
+            # one server-side hop — the receiver answers locally or
+            # refuses typed, never forwards again
+            req["proxied"] = True
         body = self._call(MessageCode.STATIC_UPDATE_OBJECTS, req)
         return body["commit_clock"]
 
     def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
                      clock: Optional[Sequence[int]] = None,
-                     deadline_ms: Optional[float] = None):
+                     deadline_ms: Optional[float] = None,
+                     proxied: bool = False):
         req = {
             "objects": list(objects),
             "clock": None if clock is None else [int(x) for x in clock],
         }
         if deadline_ms is not None:
             req["deadline_ms"] = float(deadline_ms)
+        if proxied:
+            # no-reproxy flag (ISSUE 17): one hop max
+            req["proxied"] = True
         body = self._call(MessageCode.STATIC_READ_OBJECTS, req)
         return ([decode_value(v) for v in body["values"]],
                 body["commit_clock"])
@@ -366,6 +396,11 @@ class ApbClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._rfile = self._sock.makefile("rb")
+        #: last ring hint learned from a reply (ISSUE 17): proxied reads
+        #: carry it as an optional msgpack field, typed redirects as the
+        #: errmsg-encoded ``fleet=`` param — same consumer contract as
+        #: the native client's attribute
+        self.ring_hint: Optional[dict] = None
 
     def _call(self, name: str, body: Dict[str, Any]):
         from antidote_tpu.proto import apb
@@ -386,6 +421,12 @@ class ApbClient:
         if resp_name == "ApbErrorResp":
             err = apb.parse_error_text(resp.get("errmsg", b""))
             kind, detail = err["kind"], err["detail"]
+            if err.get("fleet") or err.get("redirect"):
+                self.ring_hint = {
+                    "owner": err.get("redirect"),
+                    "followers": err.get("fleet") or [],
+                    "vnodes": None,
+                }
             if kind == "busy":
                 raise RemoteBusy(detail, err["retry_after_ms"])
             if kind == "deadline":
@@ -397,7 +438,12 @@ class ApbClient:
             if kind == "lagging":
                 raise RemoteLagging(detail, err["retry_after_ms"],
                                     redirect=err["redirect"])
+            if kind == "forward_failed":
+                raise RemoteForwardFailed(detail)
             raise RemoteError(f"{kind}: {detail}")
+        hint = resp.get("ring_hint") if isinstance(resp, dict) else None
+        if hint is not None:
+            self.ring_hint = msgpack.unpackb(hint, raw=False)
         return resp_name, resp
 
     @staticmethod
@@ -503,6 +549,9 @@ class SessionClient:
         #: served per endpoint (the fleet-smoke arc coverage signal)
         self.redirects = 0
         self.failovers = 0
+        #: ring hints absorbed from server replies (ISSUE 17): each one
+        #: refreshed the fleet/owner in place with zero extra round trips
+        self.hints_applied = 0
         self.served_by: Dict[Tuple[str, int], int] = {}
         self.followers: List[Tuple[str, int]] = []
         self.ring = HashRing((), vnodes=self.ring_vnodes, seed=self.seed)
@@ -564,6 +613,28 @@ class SessionClient:
         """Fold an observed clock into the session token."""
         self.token = merge_clock(self.token, clock)
 
+    def _absorb_hint(self, conn) -> None:
+        """Apply a server-attached ring hint (ISSUE 17) in place: a
+        follower that proxied/redirected for us tells us the current
+        owner + fleet, so the NEXT read routes zero-hop — no
+        refresh_fleet round trip.  A hint never shrinks knowledge: an
+        owner-only hint (errmsg redirect) leaves the ring alone."""
+        hint = getattr(conn, "ring_hint", None)
+        if not hint:
+            return
+        conn.ring_hint = None
+        changed = False
+        owner = hint.get("owner")
+        if owner and (owner[0], int(owner[1])) != self.owner:
+            self.owner = (owner[0], int(owner[1]))
+            changed = True
+        fleet = [(h, int(p)) for h, p in (hint.get("followers") or ())]
+        if fleet and fleet != self.followers:
+            self._set_fleet(fleet)
+            changed = True
+        if changed:
+            self.hints_applied += 1
+
     # -- session ops -----------------------------------------------------
     def update_objects(self, updates: Sequence[Tuple]) -> List[int]:
         """Session write: always the owner; the commit clock folds into
@@ -579,13 +650,16 @@ class SessionClient:
         last: Optional[BaseException] = None
         for _attempt in range(2):
             try:
-                vc = self._conn(self.owner).update_objects(
+                addr = self.owner
+                vc = self._conn(addr).update_objects(
                     updates, clock=self.token)
                 self.observe(vc)
+                self._absorb_hint(self._conns.get(addr))
                 return vc
             except RemoteNotOwner as e:
                 # the "owner" endpoint is itself a follower (operator
                 # misconfiguration) but told us where to go
+                self._absorb_hint(self._conns.get(self.owner))
                 if not e.redirect:
                     raise
                 self.redirects += 1
@@ -645,10 +719,12 @@ class SessionClient:
                     objects, clock=self.token)
             except RemoteLagging as e:
                 self.redirects += 1
+                self._absorb_hint(self._conns.get(addr))
                 last = e
                 continue
             except RemoteNotOwner as e:
                 self.redirects += 1
+                self._absorb_hint(self._conns.get(addr))
                 last = e
                 continue
             except (ConnectionError, OSError) as ex:
@@ -661,6 +737,9 @@ class SessionClient:
                 last = ex
                 continue
             self.observe(vc)
+            # a PROXIED reply carries the ring hint: absorb it so the
+            # next read for this arc routes zero-hop
+            self._absorb_hint(self._conns.get(addr))
             self.served_by[addr] = self.served_by.get(addr, 0) + 1
             return vals, vc
         if self._discover and _relearn:
@@ -687,6 +766,7 @@ class SessionClient:
                           for (h, p), n in sorted(self.served_by.items())},
             "redirects": self.redirects,
             "failovers": self.failovers,
+            "hints_applied": self.hints_applied,
         }
 
     def close(self) -> None:

@@ -33,6 +33,7 @@ from antidote_tpu.overload import (
     BusyError,
     ColdMiss,
     DeadlineExceeded,
+    ForwardFailed,
     NotOwnerError,
     ReadOnlyError,
     ReplicaLagging,
@@ -40,6 +41,7 @@ from antidote_tpu.overload import (
     deadline_from_ms,
 )
 from antidote_tpu.proto import apb
+from antidote_tpu.proto.proxy import ProxyExhausted, ProxyPlane
 from antidote_tpu.proto.codec import (
     MessageCode,
     decode,
@@ -140,7 +142,8 @@ class ProtocolServer:
                  snapshot_cache_size: Optional[int] = None,
                  group_commit_window_us: float = 0.0,
                  follower=None, native_frontend: bool = False,
-                 native_mirror_cap: int = 1 << 18):
+                 native_mirror_cap: int = 1 << 18,
+                 server_proxy: bool = True):
         self.node = node
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
@@ -162,6 +165,13 @@ class ProtocolServer:
                 "a follower server requires batch_static=True (the "
                 "inline read path bypasses the replica's commit-lock "
                 "read discipline)")
+        #: symmetric serving fabric (ISSUE 17): on a follower, out-of-arc
+        #: session reads proxy one hop to the arc owner and writes/txns
+        #: forward to the owner write plane instead of bouncing typed
+        #: redirects; ``server_proxy=False`` is the operator escape hatch
+        #: back to the PR 9 refuse-and-redirect behavior
+        self.proxy: Optional[ProxyPlane] = None
+        self._server_proxy = bool(server_proxy)
         self._lock = threading.Lock()
         self._txns: Dict[int, Transaction] = {}
         #: metric sink for the overload planes: the node's own registry
@@ -175,6 +185,8 @@ class ProtocolServer:
             from antidote_tpu.obs import NodeMetrics
 
             self.metrics = NodeMetrics()
+        if follower is not None and self._server_proxy:
+            self.proxy = ProxyPlane(follower, self.metrics)
         #: overload admission (PR 4): global + per-client (peer host)
         #: in-flight caps.  Past a cap, the request is answered with a
         #: typed busy error carrying a retry-after hint — never parked
@@ -507,6 +519,7 @@ class ProtocolServer:
                 "retry_after_ms": int(e.retry_after_ms),
                 "redirect": e.redirect,
             }
+            self._attach_hint(resp)
         except ColdMiss as e:
             # cold-tier fault-in refused (rate cap / I/O fault / CRC
             # failure): the key's device row stays cold this round —
@@ -522,6 +535,18 @@ class ProtocolServer:
                 "error": "not_owner", "detail": str(e),
                 "redirect": e.redirect,
             }
+            self._attach_hint(resp)
+        except ForwardFailed as e:
+            # a server-side forwarded write lost the owner connection
+            # AFTER the request left the socket: at-most-once forbids a
+            # blind resend, so the typed reply tells the CLIENT the op
+            # may have executed (re-read at the session token to learn
+            # the outcome)
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "forward_failed", "detail": str(e),
+                "maybe_executed": True,
+            }
+            self._attach_hint(resp)
         except ReadOnlyError as e:
             resp_code, resp = MessageCode.ERROR_RESP, {
                 "error": "read_only", "detail": str(e)
@@ -552,12 +577,27 @@ class ProtocolServer:
             time.sleep(float(d.arg or 0.01))
         return frame
 
+    def _attach_hint(self, resp: dict) -> None:
+        """Ring-hint header (ISSUE 17): follower replies that imply the
+        client mis-routed (proxied reads, typed redirects) carry the
+        current fleet+owner so capable clients refresh their ring in
+        place and converge back to zero-hop."""
+        if self.proxy is not None:
+            hint = self.proxy.ring_hint()
+            if hint is not None:
+                resp["ring_hint"] = hint
+
     def _abort_orphan(self, txid: int) -> None:
         """Roll back a transaction whose client connection died."""
         with self._lock:
             txn = self._txns.pop(txid, None)
             if txn is not None and txn.active:
                 self.node.abort_transaction(txn)
+        if (txn is None and self.proxy is not None
+                and txid in self.proxy.forwarded_txns):
+            # a FORWARDED interactive txn's edge client died: this node
+            # holds no Transaction object — relay the abort to the owner
+            self.proxy.abort_forwarded(txid)
 
     # ------------------------------------------------------------------
     # native front-end drain plane (ISSUE 16)
@@ -1314,6 +1354,132 @@ class ProtocolServer:
             pending = retry
 
     # ------------------------------------------------------------------
+    # symmetric serving fabric (ISSUE 17): follower entrypoints
+    # ------------------------------------------------------------------
+    def _follower_entry(self, code: MessageCode, body, deadline):
+        """Write/txn traffic arriving at a follower.  Returns the
+        ``(resp_code, resp)`` pair when the fabric handled (forwarded or
+        refused) the request, None to continue the normal serving path.
+
+        DC-mesh mutations stay refused outright: CONNECT_TO_DCS would
+        subscribe the FOLLOWER to a peer DC's stream — it would then
+        apply foreign-origin txns the owner never replicated, i.e.
+        guaranteed divergence + an endless heal loop — and forwarding
+        them would silently mutate the owner's mesh behind the
+        operator's back."""
+        fol = self.follower
+        plane = self.proxy
+        if code in (MessageCode.CONNECT_TO_DCS, MessageCode.CREATE_DC):
+            self.metrics.session_redirects.inc(kind="not_owner",
+                                               dialect="native")
+            raise NotOwnerError(fol.owner_client_addr)
+        if code == MessageCode.STATIC_UPDATE_OBJECTS:
+            if plane is None or body.get("proxied"):
+                # one hop max: a FORWARDED write landing back on a
+                # follower means the fleet disagrees about who owns the
+                # write plane — refuse typed rather than loop
+                self.metrics.session_redirects.inc(kind="not_owner",
+                                                   dialect="native")
+                raise NotOwnerError(fol.owner_client_addr)
+            vc = plane.forward_update(
+                _decode_updates(body["updates"]), body.get("clock"),
+                deadline,
+            )
+            return MessageCode.COMMIT_RESP, {
+                "commit_clock": [int(x) for x in vc]
+            }
+        if code in (MessageCode.START_TRANSACTION,
+                    MessageCode.READ_OBJECTS,
+                    MessageCode.UPDATE_OBJECTS,
+                    MessageCode.COMMIT_TRANSACTION,
+                    MessageCode.ABORT_TRANSACTION):
+            if plane is None or body.get("proxied"):
+                if code in (MessageCode.START_TRANSACTION,
+                            MessageCode.UPDATE_OBJECTS,
+                            MessageCode.COMMIT_TRANSACTION):
+                    self.metrics.session_redirects.inc(kind="not_owner",
+                                                       dialect="native")
+                    raise NotOwnerError(fol.owner_client_addr)
+                # READ/ABORT keep their pre-fabric unknown-txn answers
+                return None
+            return self._forward_txn_op(plane, code, body)
+        return None
+
+    def _forward_txn_op(self, plane: ProxyPlane, code: MessageCode, body):
+        """Relay one interactive-txn op over the sticky owner channel.
+        The owner's reply bodies are the native wire shapes already —
+        relay them verbatim (the txid is the OWNER's: the follower holds
+        no Transaction object, only forwarded-txn bookkeeping so a dead
+        edge connection still aborts its orphans)."""
+        if code == MessageCode.START_TRANSACTION:
+            resp = plane.txn_call(code, body)
+            plane.forwarded_txns.add(resp["txid"])
+            return MessageCode.START_TRANSACTION_RESP, resp
+        if code == MessageCode.READ_OBJECTS:
+            return MessageCode.READ_OBJECTS_RESP, plane.txn_call(code, body)
+        if code == MessageCode.UPDATE_OBJECTS:
+            try:
+                resp = plane.txn_call(code, body)
+            except AbortError:
+                # the owner aborted + unregistered the txn
+                plane.forwarded_txns.discard(body.get("txid"))
+                raise
+            return MessageCode.OPERATION_RESP, resp
+        if code == MessageCode.COMMIT_TRANSACTION:
+            try:
+                resp = plane.txn_call(code, body)
+            except BusyError:
+                raise  # txn stays OPEN at the owner — retryable
+            except BaseException:
+                plane.forwarded_txns.discard(body.get("txid"))
+                raise
+            plane.forwarded_txns.discard(body.get("txid"))
+            return MessageCode.COMMIT_RESP, resp
+        # ABORT_TRANSACTION
+        resp = plane.txn_call(code, body)
+        plane.forwarded_txns.discard(body.get("txid"))
+        return MessageCode.OPERATION_RESP, resp
+
+    def _follower_read(self, objs, clock, deadline, dialect: str = "native",
+                       proxied: bool = False):
+        """Session read at a follower entrypoint.  Returns
+        ``(out, via_proxy)``: in-arc keys serve locally (token-gated,
+        with a server-side proxy failover when the gate refuses);
+        out-of-arc keys proxy one hop to the arc owner.  A PROXIED
+        request never re-proxies (the forwarding node owns failover) and
+        typed lagging surfaces only when every avenue is exhausted."""
+        fol = self.follower
+        plane = self.proxy
+        wants_bytes = dialect == "native"
+
+        def _local():
+            fol.gate_read(objs, _vc(clock), deadline, dialect=dialect)
+            return self.static_read(objs, clock, deadline=deadline,
+                                    wants_bytes=wants_bytes), False
+
+        if plane is None or proxied:
+            return _local()
+        target = plane.route(objs)
+        if target is None:
+            # in-arc: serve locally; a gate refusal (lagging/bootstrap)
+            # fails over server-side to a live peer instead of bouncing
+            # a typed redirect to a client that routed CORRECTLY
+            try:
+                return _local()
+            except ReplicaLagging as gate_err:
+                try:
+                    return plane.proxy_read(objs, clock, deadline), True
+                except ProxyExhausted:
+                    raise gate_err from None
+        try:
+            return plane.proxy_read(objs, clock, deadline,
+                                    first=target), True
+        except ProxyExhausted:
+            # every remote hop failed: terminal local attempt — the
+            # gate's typed refusal is the honest last resort
+            return _local()
+
+    # ------------------------------------------------------------------
     def _process(self, code: MessageCode, body: Any):
         # per-request deadline: client-supplied relative ``deadline_ms``
         # (native dialect only), else the configured server default.
@@ -1322,27 +1488,16 @@ class ProtocolServer:
             body.get("deadline_ms") if isinstance(body, dict) else None,
             self.default_deadline_ms,
         )
-        # follower replicas (ISSUE 9) are read-only: writes and
-        # interactive transactions answer a typed not_owner redirect
-        # naming the owner's endpoint, and session reads pass the
-        # follower's applied-clock gate before any dispatch (park
-        # briefly, then a typed lagging redirect — never a stale read
-        # against a session token)
+        # follower replicas: PR 9 refused every write/txn with a typed
+        # not_owner redirect; with the serving fabric (ISSUE 17) the
+        # follower instead FORWARDS them to the owner write plane and
+        # answers like any node — typed errors surface only when
+        # forwarding is exhausted (or with --no-server-proxy)
         fol = self.follower
-        if fol is not None and code in (
-                MessageCode.STATIC_UPDATE_OBJECTS,
-                MessageCode.START_TRANSACTION,
-                MessageCode.UPDATE_OBJECTS,
-                MessageCode.COMMIT_TRANSACTION,
-                # DC-mesh mutations too: CONNECT_TO_DCS would subscribe
-                # the FOLLOWER to a peer DC's stream — it would then
-                # apply foreign-origin txns the owner never replicated,
-                # i.e. guaranteed divergence + an endless heal loop
-                MessageCode.CONNECT_TO_DCS,
-                MessageCode.CREATE_DC):
-            self.metrics.session_redirects.inc(kind="not_owner",
-                                               dialect="native")
-            raise NotOwnerError(fol.owner_client_addr)
+        if fol is not None:
+            handled = self._follower_entry(code, body, deadline)
+            if handled is not None:
+                return handled
         # static ops route through the gate helpers OUTSIDE the lock (the
         # gate's dispatcher takes it; with batching off they lock inline)
         # — the ONLY static dispatch path, so it cannot drift from a
@@ -1350,11 +1505,25 @@ class ProtocolServer:
         if code == MessageCode.STATIC_READ_OBJECTS:
             objs = _decode_objects(body["objects"])
             if fol is not None:
-                fol.gate_read(objs, _vc(body.get("clock")), deadline)
-            out = self.static_read(
-                objs, body.get("clock"),
-                deadline=deadline, wants_bytes=True,
-            )
+                out, via_proxy = self._follower_read(
+                    objs, body.get("clock"), deadline,
+                    proxied=bool(body.get("proxied")),
+                )
+                if via_proxy:
+                    vals, vc = out
+                    resp = {
+                        "values": [encode_value(v) for v in vals],
+                        "commit_clock": [int(x) for x in vc],
+                    }
+                    # teach the mis-routed client the ring so it
+                    # converges back to zero-hop
+                    self._attach_hint(resp)
+                    return MessageCode.READ_OBJECTS_RESP, resp
+            else:
+                out = self.static_read(
+                    objs, body.get("clock"),
+                    deadline=deadline, wants_bytes=True,
+                )
             if isinstance(out, RawReply):
                 # batched reply serialization: the writeback stage framed
                 # the response; the handler sends the bytes as-is
@@ -1589,6 +1758,8 @@ class ProtocolServer:
         }
         if self.native is not None:
             out["native"] = self.native.stats()
+        if self.proxy is not None:
+            out["proxy"] = self.proxy.stats()
         txm = getattr(self.node, "txm", None)
         if txm is not None:
             out["snapshot_cache"]["size"] = len(txm.store.snapshot_cache)
@@ -1606,6 +1777,8 @@ class ProtocolServer:
     def close(self) -> None:
         self._closing = True
         self._ticker_stop.set()
+        if self.proxy is not None:
+            self.proxy.close()
         self._server.shutdown()
         self._server.server_close()
         if self.native is not None:

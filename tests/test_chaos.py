@@ -1673,6 +1673,189 @@ def test_coldtier_chain_storm_sigkill_corrupt_link_and_merkle_heal(tmp_path):
     owner.store.log.close(), fnode.store.log.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario 18: the symmetric serving fabric under fire (ISSUE 17) —
+# 1 owner + 3 followers (console serve), a RING-OBLIVIOUS client bolted
+# to ONE entry follower driving a mixed read/write storm with its own
+# session token, proxy hops fault-stretched (proxy.forward delay) so
+# the kill lands inside forwarded work; SIGKILL the proxy target the
+# storm's keys prefer.  Contract: the entry node fails over
+# SERVER-SIDE (local DEAD_S observation bridges the registry's
+# staleness window) — the bare client sees ZERO typed redirects and
+# read-your-writes holds on every read through the kill; a bare apb
+# client gets the same failover; acked ⊆ recovered at the owner; the
+# surviving followers' digest sweeps converge byte-identical.
+# ---------------------------------------------------------------------------
+def test_proxy_fabric_sigkill_target_serverside_failover(tmp_path):
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.proto.client import (AntidoteClient, ApbClient,
+                                           HashRing)
+
+    env_entry = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # stretch every proxy hop so the SIGKILL lands inside forwarded
+        # work instead of between requests
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 18, "rules": [
+            {"site": "proxy.forward", "action": "delay", "p": 0.25,
+             "arg": 0.02, "times": 400},
+        ]}),
+    )
+    env_plain = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn_follower(name, oinfo, env):
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--log-dir", str(tmp_path / name),
+             "--follower-of", f"{oinfo['host']}:{oinfo['port']}",
+             "--replica-name", name, "--follower-park-ms", "100",
+             "--divergence-check-s", "0.5"],
+            stdout=subprocess.PIPE,
+            stderr=open(str(tmp_path / (name + ".log")), "a"),
+            env=env, text=True,
+        )
+
+    owner = subprocess.Popen(
+        [sys.executable, "-m", "antidote_tpu.console", "serve",
+         "--port", "0", "--shards", "2", "--max-dcs", "2",
+         "--log-dir", str(tmp_path / "owner"), "--interdc",
+         "--interdc-port", "0", "--checkpoint-interval-s", "0.5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env_plain, text=True,
+    )
+    procs = [owner]
+    try:
+        oinfo = json.loads(owner.stdout.readline())
+        assert oinfo["ready"] is True
+        oc = AntidoteClient(oinfo["host"], oinfo["port"])
+        keys = [f"ck{i}" for i in range(10)]
+        totals = {k: 0 for k in keys}
+        for k in keys:
+            oc.update_objects([(k, "counter_pn", "b", ("increment", 1))])
+            totals[k] += 1
+        deadline = time.monotonic() + 30
+        while (oc.node_status().get("checkpoint", {}).get("last_id")
+               or 0) < 1:
+            assert time.monotonic() < deadline, "no owner checkpoint"
+            time.sleep(0.1)
+        infos = []
+        for i in range(3):
+            p = spawn_follower(f"f{i + 1}", oinfo,
+                               env_entry if i == 0 else env_plain)
+            procs.append(p)
+            infos.append(json.loads(p.stdout.readline()))
+        assert all(i["ready"] for i in infos)
+        eps = [(i["host"], i["port"]) for i in infos]
+
+        # the entry node must learn the full serving fleet (liveness
+        # reports piggyback the registry snapshot) before the storm
+        fc = AntidoteClient(*eps[0])
+        deadline = time.monotonic() + 30
+        while True:
+            st = fc.node_status()["pipeline"]["proxy"]
+            if len(st["fleet"]["endpoints"]) == 3:
+                break
+            assert time.monotonic() < deadline, st
+            time.sleep(0.2)
+
+        # placement is unseeded and fleet-wide: the test computes every
+        # node's arc assignment with the same ring the planes run, and
+        # kills the follower that owns the FIRST key's arc (never the
+        # entry node — re-pick the key if needed)
+        ring = HashRing(eps, vnodes=64)
+        victim_key = next(k for k in keys
+                          if ring.preferred(k, "b") != eps[0])
+        victim_ep = ring.preferred(victim_key, "b")
+        victim = procs[1 + eps.index(victim_ep)]
+
+        # phase 1: ring-oblivious mixed storm through the ONE entry
+        # follower — every write forwards, every read holds RYW
+        vc = None
+        for r in range(4):
+            for k in keys:
+                vc = fc.update_objects(
+                    [(k, "counter_pn", "b", ("increment", 1))], clock=vc)
+                totals[k] += 1
+                vals, vc = fc.read_objects([(k, "counter_pn", "b")],
+                                           clock=vc)
+                assert vals == [totals[k]], (k, vals, totals[k])
+
+        # phase 2: SIGKILL the proxy target mid-storm and keep going —
+        # zero typed errors allowed; the entry node's local fleet
+        # health covers the registry's REPLICA_DOWN_S staleness window
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        for r in range(4):
+            for k in keys:
+                vc = fc.update_objects(
+                    [(k, "counter_pn", "b", ("increment", 1))], clock=vc)
+                totals[k] += 1
+                vals, vc = fc.read_objects([(k, "counter_pn", "b")],
+                                           clock=vc)
+                assert vals == [totals[k]], (k, vals, totals[k])
+        st = fc.node_status()["pipeline"]["proxy"]
+        assert st["forwarded"]["write"] >= 8 * len(keys)
+        assert st["forwarded"]["read"] >= 1
+        assert st["forwarded"]["failover"] >= 1, st
+
+        # a bare apb client at the same entry follower gets the same
+        # server-side failover + RYW (bytes keyspace)
+        ac = ApbClient(*eps[0])
+        avc = ac.update_objects([(victim_key.encode(), "counter_pn",
+                                  b"b", ("increment", 1))])
+        avals, _ = ac.read_objects([(victim_key.encode(), "counter_pn",
+                                     b"b")], clock=avc)
+        assert avals == [1]
+        ac.close()
+
+        # acked ⊆ recovered: every acked increment is visible at the
+        # owner (no ForwardFailed surfaced, so acked == recovered)
+        ovals, ovc = oc.read_objects([(k, "counter_pn", "b")
+                                      for k in keys])
+        assert ovals == [totals[k] for k in keys]
+
+        # surviving followers converge byte-identical: the periodic
+        # digest sweep compares clean against the owner, zero mismatch
+        for ep in eps:
+            if ep == victim_ep:
+                continue
+            c = AntidoteClient(*ep)
+            deadline = time.monotonic() + 60
+            while True:
+                rs = c.node_status()["replicas"]
+                if (rs["state"] == "serving"
+                        and rs["divergence"].get("ok", 0) >= 1
+                        and rs["divergence"].get("mismatch", 0) == 0):
+                    break
+                assert time.monotonic() < deadline, rs
+                time.sleep(0.2)
+            c.close()
+        # the owner's registry agrees about who is dead
+        deadline = time.monotonic() + 30
+        vname = f"f{1 + eps.index(victim_ep)}"
+        while True:
+            reg = oc.replica_admin("status")["followers"]
+            if reg[vname]["state"] == "down":
+                break
+            assert time.monotonic() < deadline, reg
+            time.sleep(0.2)
+        fc.close()
+        oc.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 @pytest.mark.slow
 def test_storm_soak_many_rounds(cfg):
     """A longer seeded storm across 3 DCs with partitions opening and

@@ -270,21 +270,25 @@ def test_corrupt_newest_owner_image_falls_back_older(cfg, tmp_path):
 
 
 def test_apb_dialect_refused_on_follower(cfg, tmp_path):
-    """The apb wire dialect is refused whole on a follower: its
-    handlers would dispatch writes straight into the txn layer,
-    bypassing both the not_owner refusal and the session gate — an
-    acked-then-discarded write is worse than a typed refusal."""
+    """The follower's apb edge stays safe without a proxy plane: with
+    ``--no-server-proxy`` every apb write/txn request answers the
+    typed not_owner redirect (never an acked-then-discarded write).
+    With the plane attached but the owner UNREACHABLE, forwarding
+    exhausts its send-phase dial budget and degrades to the SAME typed
+    redirect — the fabric never invents a third failure mode."""
     import socket
     import struct
 
     from antidote_tpu.proto import apb as apb_mod
+    from antidote_tpu.proto.client import ApbClient, RemoteNotOwner
     from antidote_tpu.proto.server import ProtocolServer
 
     hub = LoopbackHub()
     owner, orep = mk_owner(cfg, hub, tmp_path)
     owner.update_objects([("k", "counter_pn", "b", ("increment", 1))])
     fnode, fol, _ = mk_follower(cfg, hub, tmp_path, orep)
-    srv = ProtocolServer(fnode, port=0, follower=fol)
+    srv = ProtocolServer(fnode, port=0, follower=fol,
+                         server_proxy=False)
     try:
         code = sorted(apb_mod.APB_REQUEST_CODES)[0]
         sock = socket.create_connection((srv.host, srv.port), timeout=10)
@@ -297,6 +301,19 @@ def test_apb_dialect_refused_on_follower(cfg, tmp_path):
             reply += sock.recv(n - len(reply))
         assert b"not_owner" in reply, reply
         sock.close()
+        # plane attached, owner unreachable (the fake bootstrap addr):
+        # a well-formed apb write exhausts the dial budget and surfaces
+        # the typed redirect carrying the owner endpoint
+        srv2 = ProtocolServer(fnode, port=0, follower=fol)
+        try:
+            fc = ApbClient(srv2.host, srv2.port)
+            with pytest.raises(RemoteNotOwner) as ei:
+                fc.update_objects([(b"k", "counter_pn", b"b",
+                                    ("increment", 1))])
+            assert ei.value.redirect == ["owner-host", 1234]
+            fc.close()
+        finally:
+            srv2.close()
         # a follower server also refuses the unsafe inline-read mode
         with pytest.raises(ValueError, match="batch_static"):
             ProtocolServer(fnode, port=0, follower=fol,
@@ -532,13 +549,13 @@ def test_geo_owner_shadowing_peer_chains(cfg, tmp_path):
 
 def test_apb_session_tier_on_follower(cfg, tmp_path):
     """The apb protobuf dialect gets the SAME session discipline the
-    msgpack dialect has on a follower (ISSUE 11): token-gated static
-    reads serve (with RYW via the session token), writes/txns answer
-    typed not_owner redirects, and a stale replica answers typed
-    lagging — all errmsg-encoded on ApbErrorResp and decoded back by
-    the apb client into the same Remote* exceptions."""
-    from antidote_tpu.proto.client import (ApbClient, RemoteLagging,
-                                           RemoteNotOwner, SessionClient)
+    msgpack dialect has on a follower (ISSUE 11) — and with the
+    symmetric serving fabric (ISSUE 17) the follower is a safe apb
+    entrypoint: writes FORWARD to the owner write plane instead of
+    bouncing on a typed not_owner, a token-ahead read fails over
+    server-side to the owner instead of surfacing typed lagging, and
+    the session tier keeps read-your-writes either way."""
+    from antidote_tpu.proto.client import ApbClient, SessionClient
     from antidote_tpu.proto.server import ProtocolServer
 
     hub = LoopbackHub()
@@ -550,14 +567,18 @@ def test_apb_session_tier_on_follower(cfg, tmp_path):
     fsrv = ProtocolServer(fnode, port=0, follower=fol)
     fol.owner_client_addr = (osrv.host, osrv.port)
     try:
-        # apb write at the follower: typed not_owner WITH the redirect
+        # apb write at the follower: forwarded to the owner write plane
+        # with RYW at the returned commit clock (the apb keyspace is
+        # bytes — distinct from the native str "k" above)
         fc = ApbClient(fsrv.host, fsrv.port)
-        with pytest.raises(RemoteNotOwner) as ei:
-            fc.update_objects([(b"k", "counter_pn", b"b",
-                                ("increment", 1))])
-        assert ei.value.redirect == [osrv.host, osrv.port]
+        vc = fc.update_objects([(b"k", "counter_pn", b"b",
+                                 ("increment", 1))])
+        vals, _ = fc.read_objects([(b"k", "counter_pn", b"b")],
+                                  clock=vc)
+        assert vals == [1]
         assert fnode.metrics.session_redirects.value(
-            kind="not_owner", dialect="apb") >= 1
+            kind="not_owner", dialect="apb") == 0
+        assert fsrv.proxy.counts["write"] >= 1
         # apb session over the fleet: write owner, read follower, RYW
         sc = SessionClient((osrv.host, osrv.port),
                            [(fsrv.host, fsrv.port)], dialect="apb")
@@ -576,16 +597,19 @@ def test_apb_session_tier_on_follower(cfg, tmp_path):
             vals, _ = sc.read_objects([(b"ak", "counter_pn", b"b")])
             assert vals == [total], (i, vals, total)
         assert sc.served_by.get((fsrv.host, fsrv.port), 0) >= 1
-        # a token ahead of the replica: typed lagging with retry hint +
-        # redirect, errmsg round-tripped
-        ahead = [int(x) + 50 for x in owner.store.dc_max_vc()]
+        # a token ahead of the replica (in the owner's own lane): the
+        # gate refuses locally but the fabric fails over SERVER-SIDE to
+        # the owner — the bare apb client gets the value, not typed
+        # lagging, and the proxied reply teaches it the ring
+        ahead = [int(x) for x in owner.store.dc_max_vc()]
+        ahead[0] += 50
         fc2 = ApbClient(fsrv.host, fsrv.port)
-        with pytest.raises(RemoteLagging) as ei:
-            fc2.read_objects([(b"ak", "counter_pn", b"b")], clock=ahead)
-        assert ei.value.retry_after_ms > 0
-        assert ei.value.redirect == [osrv.host, osrv.port]
-        assert fnode.metrics.session_redirects.value(
-            kind="lagging", dialect="apb") >= 1
+        vals, _ = fc2.read_objects([(b"ak", "counter_pn", b"b")],
+                                   clock=ahead)
+        assert vals == [total]
+        assert fsrv.proxy.counts["read"] >= 1
+        assert fc2.ring_hint is not None
+        assert fc2.ring_hint["owner"] == [osrv.host, osrv.port]
         fc.close(), fc2.close(), sc.close()
     finally:
         fsrv.close()
@@ -678,8 +702,7 @@ def test_wire_session_survives_follower_kill_and_rejoin(cfg, tmp_path):
     one follower mid-session (client fails over with read-your-writes
     held), rejoin it from its image, converge byte-identical."""
     from antidote_tpu.interdc.tcp import TcpFabric
-    from antidote_tpu.proto.client import (AntidoteClient, RemoteNotOwner,
-                                           SessionClient)
+    from antidote_tpu.proto.client import AntidoteClient, SessionClient
     from antidote_tpu.proto.server import ProtocolServer
 
     ofab = TcpFabric(backoff_base=0.05, backoff_max=0.5)
@@ -698,12 +721,15 @@ def test_wire_session_survives_follower_kill_and_rejoin(cfg, tmp_path):
         assert f1["mode"] == "image" and f2["mode"] == "image"
         pump2 = _Pump(f1["fabric"], f2["fabric"])
         try:
-            # a write sent AT a follower answers the typed redirect
+            # a write sent AT a follower FORWARDS to the owner write
+            # plane (ISSUE 17): the ring-oblivious client gets a commit
+            # clock and read-your-writes, not a typed redirect
             fc = AntidoteClient(f1["srv"].host, f1["srv"].port)
-            with pytest.raises(RemoteNotOwner) as ei:
-                fc.update_objects([("k", "counter_pn", "b",
-                                    ("increment", 1))])
-            assert ei.value.redirect == [osrv.host, osrv.port]
+            vc = fc.update_objects([("k", "counter_pn", "b",
+                                     ("increment", 1))])
+            vals, _ = fc.read_objects([("k", "counter_pn", "b")],
+                                      clock=vc)
+            assert vals == [5]
             fc.close()
             sc = SessionClient(
                 (osrv.host, osrv.port),
@@ -712,7 +738,7 @@ def test_wire_session_survives_follower_kill_and_rejoin(cfg, tmp_path):
             )
             # session loop: every read (served by a follower) must see
             # the session's own writes
-            total = 4
+            total = 5
             for i in range(6):
                 sc.update_objects([("k", "counter_pn", "b",
                                     ("increment", 1))])
@@ -737,13 +763,23 @@ def test_wire_session_survives_follower_kill_and_rejoin(cfg, tmp_path):
                 total += 1
                 vals, _ = sc.read_objects([("k", "counter_pn", "b")])
                 assert vals == [total], (i, vals, total)
-            # ring semantics: the dead follower served nothing after the
-            # kill — its arcs failed over when "k" preferred it (a
-            # winding-down server may answer one last typed redirect
-            # instead of a dead socket, so either counter may move),
-            # and other arcs were untouched (no stampede to assert)
-            assert sc.served_by.get(f1_addr, 0) == served_dead_before
-            if sc.ring.preferred("k", "b") == f1_addr:
+            # ring semantics under the symmetric fabric (ISSUE 17):
+            # after the wind-down the follower either drops off (dead
+            # socket / one last typed redirect — the client fails over
+            # and its served_by counter stops moving) or its
+            # still-draining server keeps the session alive by
+            # RESCUING gate refusals through the proxy plane — its
+            # applied clock is frozen (fabric closed), so any read it
+            # still answered MUST have crossed the proxy to the owner.
+            # Which branch runs depends on whether the fleet reports
+            # had distributed before the kill; both hold RYW.
+            served_delta = (sc.served_by.get(f1_addr, 0)
+                            - served_dead_before)
+            if served_delta:
+                assert f1["srv"].proxy is not None
+                assert (f1["srv"].proxy.counts["read"]
+                        >= served_delta)
+            elif sc.ring.preferred("k", "b") == f1_addr:
                 assert (sc.redirects - re_before
                         + sc.failovers - fo_before) >= 1
             # rejoin follower 1 from its local image + the owner's tail
