@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke proxy-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke proxy-smoke escrow-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -109,6 +109,18 @@ fleet-smoke:
 proxy-smoke:
 	$(PY) -m pytest tests/test_proxy.py -q
 	$(PY) bench_wire.py --proxy-fanout --smoke --assert-bounds
+
+# escrow economy (ISSUE 18): the bounded-counter suite (typed refusals,
+# conservation under seeded interleavings, apb round-trip, forwarded
+# refusals) plus one live two-DC Zipf flash-sale storm.  The gate is
+# STRUCTURAL only: zero oversell (no SKU acks past its minted
+# inventory; converged value == inventory - acked at BOTH DCs), zero
+# protocol errors, typed refusals actually seen, and live rights-
+# transfer traffic; the frozen goodput numbers in BENCH_ESCROW_cpu.json
+# are never a CI ratchet
+escrow-smoke:
+	$(PY) -m pytest tests/test_bcounter.py -q
+	$(PY) bench_wire.py --flash-sale --smoke --assert-bounds
 
 # mesh serving plane (ISSUE 10): the deterministic mesh suite on the
 # forced 8-device CPU mesh (read parity byte-identical with the

@@ -390,6 +390,16 @@ class AntidoteNode:
             "max_commit_backlog": self.txm.max_commit_backlog,
             "shed": shed,
         }
+        # escrow economy (ISSUE 18): typed bounded-counter refusals,
+        # queued shortfall, and the rights-transfer traffic this node
+        # has driven/served — the zero-oversell plane's one-call view
+        out["escrow"] = dict(
+            self.txm.bcounters.status(),
+            grants={
+                role[0]: int(v) for role, v in sorted(
+                    self.metrics.escrow_grants.snapshot().items()) if v
+            },
+        )
         # write plane (ISSUE 6): merge width, group-fsync batching,
         # per-segment durability debt, bypass counts — the knobs table
         # in docs/operations.md explains how to read these

@@ -236,6 +236,16 @@ def cmd_serve(args) -> int:
             alive=lambda lp: lp.is_alive(),
             stop=lambda lp: lp.stop(),
         )
+    if interdc is not None:
+        # the escrow rights-transfer loop (ISSUE 18): supervised like
+        # the pump — a crashed loop restarts instead of silently
+        # freezing bounded-counter grants while decrements queue up
+        sup.add(
+            "escrow-pump",
+            start=lambda: interdc.start_escrow_loop(),
+            alive=lambda lp: lp.is_alive(),
+            stop=lambda lp: lp.stop(),
+        )
     server_box = {}
 
     def start_proto():
@@ -300,6 +310,10 @@ def cmd_serve(args) -> int:
             f"(bootstrap mode={mode}, owner members={len(owner_addrs)})")
     if mesh_plane is not None:
         ready["mesh_devices"] = mesh_plane.n_devices
+    if interdc is not None:
+        # escrow plane health at boot (ISSUE 18): drivers gating on the
+        # ready line see the rights-transfer loop armed + a clean queue
+        ready["escrow"] = dict(node.txm.bcounters.status(), loop=True)
     log(f"antidote_tpu dc{args.dc_id} serving on "
         f"{server.host}:{server.port} (recovered={recover}, "
         f"keys={len(node.store.directory)}"

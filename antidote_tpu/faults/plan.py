@@ -141,6 +141,18 @@ class FaultPlan:
                                                        the client
                                                        retries on the
                                                        hint)
+    bcounter.transfer   (key, granter_dc)              escrow grant plane
+                                                       (ISSUE 18: delay
+                                                       stretches a grant
+                                                       so chaos can kill
+                                                       the granter mid-
+                                                       transfer; drop/
+                                                       error starve the
+                                                       requester — the
+                                                       at-most-once
+                                                       channel never
+                                                       blind-resends, the
+                                                       next tick re-asks)
     native_pump.load    None                           native receive plane
     ==================  =============================  =================
     """

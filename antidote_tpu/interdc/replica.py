@@ -147,12 +147,7 @@ class DCReplica:
         node.txm.commit_listeners.append(self._on_local_commit)
         node.txm.on_clock_wait = self._on_clock_wait
         # bcounter rights requests ride the query channel (?BCOUNTER_REQUEST)
-        node.txm.bcounters.request_transfer = (
-            lambda dc, key, bucket, n: self.hub.request(
-                dc, "bcounter", {"key": key, "bucket": bucket, "amount": n,
-                                 "to_dc": self.dc_id},
-            )
-        )
+        node.txm.bcounters.request_transfer = self._request_transfer
         #: clustered DCs install an intra-DC router here (attach_interdc)
         self.transfer_handler = None
         #: follower registry (ISSUE 9): name -> {addr, applied, state,
@@ -569,14 +564,40 @@ class DCReplica:
         """Generic query-channel dispatch (inter_dc_query_receive_socket,
         /root/reference/src/inter_dc_query_receive_socket.erl:111-139)."""
         if kind == "bcounter":
+            # fault site ``bcounter.transfer``: consulted at granter
+            # entry, so chaos plans can starve (drop/error) or stretch
+            # (delay — wide enough to SIGKILL a granter mid-transfer)
+            # grant traffic like every other plane
+            import errno as _errno
+
+            from antidote_tpu import faults as _faults
+
+            d = _faults.hit("bcounter.transfer",
+                            key=(payload.get("key"), self.dc_id))
+            if d is not None:
+                if d.action == "delay" and d.arg:
+                    time.sleep(float(d.arg))
+                elif d.action == "drop":
+                    raise ConnectionError(
+                        "injected fault: bcounter.transfer dropped")
+                elif d.action in ("error", "io_error", "enospc"):
+                    raise OSError(
+                        _errno.EIO,
+                        f"injected fault: bcounter.transfer "
+                        f"{payload.get('key')!r}")
             if self.transfer_handler is not None:
                 # clustered DC: route to the key's owner member, whose
                 # coordinator commits the grant through the sequencer
-                return self.transfer_handler(payload)
-            return self.node.txm.bcounters.process_transfer(
-                self.node.txm, payload["key"], payload["bucket"],
-                payload["amount"], payload["to_dc"],
-            )
+                grant = self.transfer_handler(payload)
+            else:
+                grant = self.node.txm.bcounters.process_transfer(
+                    self.node.txm, payload["key"], payload["bucket"],
+                    payload["amount"], payload["to_dc"],
+                )
+            m = getattr(self.node, "metrics", None)
+            if grant and m is not None:
+                m.escrow_grants.inc(role="granter")
+            return grant
         if kind == "check_up":
             return True
         # follower-replica plane (ISSUE 9)
@@ -599,6 +620,38 @@ class DCReplica:
             return self._serve_follower_report(payload)
         raise ValueError(f"unknown request kind {kind!r}")
 
+    def _request_transfer(self, dc: int, key, bucket: str,
+                          amount: int) -> None:
+        """One rights request over the AT-MOST-ONCE query channel.
+
+        Grants are non-idempotent commits on the granter, so a reply-
+        phase failure (timeout after the request left the socket,
+        connection lost before the reply) means the grant MAY have
+        committed remotely — this surfaces typed in the log + metrics
+        and relies on the grace throttle (set BEFORE the send in
+        transfer_periodic) instead of blind-resending; the next tick
+        past the grace window re-reads state, so an arrived grant
+        retires the shortfall instead of being asked for twice."""
+        m = getattr(self.node, "metrics", None)
+        t0 = time.monotonic()
+        try:
+            grant = self.hub.request(
+                dc, "bcounter", {"key": key, "bucket": bucket,
+                                 "amount": amount, "to_dc": self.dc_id},
+            )
+        except Exception as e:
+            log.warning(
+                "bcounter transfer request to dc%d for %r failed typed "
+                "(%s); grace throttle holds — no blind resend",
+                dc, key, e)
+            if m is not None:
+                m.escrow_grants.inc(role="failed")
+            return
+        if m is not None:
+            m.escrow_transfer_seconds.observe(time.monotonic() - t0)
+            if grant:
+                m.escrow_grants.inc(role="requester")
+
     def bcounter_tick(self) -> int:
         """Run one round of the rights-transfer loop (transfer_periodic,
         /root/reference/src/bcounter_mgr.erl:131-146)."""
@@ -608,11 +661,51 @@ class DCReplica:
         txm = self.node.txm
 
         def read_state(key, bucket):
-            return txm.store.read_states(
-                [(key, "counter_b", bucket)], txm.store.dc_max_vc()
-            )[0]
+            # under the commit lock: the write plane grows/reallocates
+            # the device tables while committing, and an unsynchronized
+            # read_latest from this loop's thread can hit a donated
+            # buffer mid-growth
+            with txm.commit_lock:
+                return txm.store.read_states(
+                    [(key, "counter_b", bucket)], txm.store.dc_max_vc()
+                )[0]
 
-        return txm.bcounters.transfer_periodic(read_state, ty)
+        sent = txm.bcounters.transfer_periodic(read_state, ty)
+        m = getattr(self.node, "metrics", None)
+        if m is not None:
+            m.escrow_shortfall.set(txm.bcounters.shortfall())
+        return sent
+
+    def start_escrow_loop(self, base_s: float = None,
+                          seed: int = None) -> "object":
+        """The supervised background rights-transfer loop (ISSUE 18;
+        bcounter_mgr's ?TRANSFER_FREQ timer) — same ThreadLoop
+        discipline as the clock-gossip/pump loops: crashes end the
+        thread loudly and the supervisor restarts it.  The interval is
+        JITTERED around the base while demand is queued (two DCs'
+        loops must not phase-lock their grant traffic) and backs off
+        up to 5x base when the queue is empty, snapping back on the
+        first refusal."""
+        import random
+
+        from antidote_tpu.supervise import ThreadLoop
+        from antidote_tpu.txn.bcounter import TRANSFER_FREQ
+
+        base = float(base_s) if base_s is not None else TRANSFER_FREQ
+        rng = random.Random(seed if seed is not None else self.dc_id)
+        loop = ThreadLoop(lambda: None, interval_s=base,
+                          name=f"escrow-pump-{self.name}")
+
+        def tick():
+            self.bcounter_tick()
+            if self.node.txm.bcounters.pending:
+                loop.interval_s = base * (0.5 + rng.random())
+            else:
+                loop.interval_s = min(loop.interval_s * 1.5 + 1e-3,
+                                      base * 5.0)
+
+        loop.fn = tick
+        return loop.start()
 
     def _serve_log_query(self, shard: int, origin: int,
                          from_opid: int) -> List[bytes]:

@@ -712,6 +712,36 @@ class NodeMetrics:
             "re-bootstrap fallback)",
             ("mode",),
         )
+        # escrow economy (ISSUE 18): bounded-counter refusals, rights
+        # grants by role, transfer round-trip latency, and the queued
+        # shortfall the background rights-transfer loop is working off
+        self.escrow_refusals = r.counter(
+            "antidote_escrow_refusals_total",
+            "counter_b decrements/transfers refused typed by the "
+            "group-commit escrow certification (insufficient locally-"
+            "held rights; zero oversell is the invariant this buys)",
+        )
+        self.escrow_grants = r.counter(
+            "antidote_escrow_grants_total",
+            "Escrow rights-transfer grants by role (granter = this node "
+            "committed a transfer out of its lane; requester = a grant "
+            "this node asked for landed; failed = a request refused, "
+            "lost, or surfaced typed on the at-most-once channel — "
+            "never blind-resent)",
+            ("role",),
+        )
+        self.escrow_transfer_seconds = r.histogram(
+            "antidote_escrow_transfer_seconds",
+            "Rights-transfer request round trip on the inter-DC query "
+            "channel, send to decoded grant (s)",
+            buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.escrow_shortfall = r.gauge(
+            "antidote_escrow_shortfall",
+            "Rights currently queued for by refused decrements (the "
+            "background transfer loop's pending demand; 0 = every "
+            "refusal has been covered or retired)",
+        )
         # process-wide fabric/RPC resilience counters ride along in this
         # node's exposition (shared objects — see NetMetrics)
         net_metrics().attach(r)

@@ -106,6 +106,28 @@ class ReplicaDown(ConnectionError):
     client's failover loop."""
 
 
+class InsufficientRightsError(Exception):
+    """A bounded-counter (``counter_b``) decrement/transfer asked for
+    more rights than this DC's escrow lane holds (ISSUE 18).  The op was
+    NOT executed and nothing in the batch it rode was partially applied
+    — the group-commit escrow pass NACKs exactly the refused sub-group.
+    ``retry_after_ms`` scales with the expected grant arrival: the
+    background rights-transfer loop has already been told about the
+    shortfall, so the hint tracks its next tick (deeper refusal streaks
+    mean rights are scarce fleet-wide and back off harder).  Zero
+    oversell is the invariant this error buys: refusing typed here is
+    what lets both sides of a partition keep selling their own escrow
+    safely."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 100,
+                 key=None, needed: int = 0, held: int = 0):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+        self.key = key
+        self.needed = int(needed)
+        self.held = int(held)
+
+
 class ForwardFailed(Exception):
     """A server-side forwarded write (ISSUE 17) lost its owner
     connection AFTER the request left the socket: the owner **may have
@@ -222,5 +244,5 @@ class AdmissionGate:
 
 __all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
            "NotOwnerError", "ReplicaLagging", "ReplicaDown", "ColdMiss",
-           "ForwardFailed", "AdmissionGate", "deadline_from_ms",
-           "check_deadline", "retry_hint_ms"]
+           "ForwardFailed", "InsufficientRightsError", "AdmissionGate",
+           "deadline_from_ms", "check_deadline", "retry_hint_ms"]

@@ -612,11 +612,17 @@ def _error_resp(e, server=None) -> Tuple[str, Dict[str, Any]]:
     errmsg-encoded fleet hint."""
     from antidote_tpu.overload import (BusyError, ColdMiss,
                                        DeadlineExceeded, ForwardFailed,
+                                       InsufficientRightsError,
                                        NotOwnerError, ReadOnlyError,
                                        ReplicaLagging)
 
     if isinstance(e, BusyError):
         text = error_text("busy", str(e), e.retry_after_ms)
+    elif isinstance(e, InsufficientRightsError):
+        # escrow refusal (ISSUE 18): counter_b rights exceeded — the
+        # hint tracks the background transfer loop's expected grant
+        text = error_text("insufficient_rights", str(e),
+                          e.retry_after_ms)
     elif isinstance(e, ColdMiss):
         text = error_text("cold_miss", str(e), e.retry_after_ms)
     elif isinstance(e, DeadlineExceeded):

@@ -88,6 +88,19 @@ class RemoteForwardFailed(RemoteError):
         self.maybe_executed = True
 
 
+class RemoteInsufficientRights(RemoteError):
+    """A bounded-counter (``counter_b``) decrement/transfer exceeded the
+    serving DC's locally-held escrow rights — the op was NOT executed
+    (zero oversell).  ``retry_after_ms`` is scaled by the expected grant
+    arrival: the server's background rights-transfer loop has been told
+    about the shortfall, so waiting out the hint usually finds rights
+    rebalanced here."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 100):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
 class RemoteColdMiss(RemoteError):
     """A cold-tier key's fault-in was refused (rate cap, I/O fault, or
     sidecar CRC failure): the read/write was NOT served — retry after
@@ -189,6 +202,10 @@ class AntidoteClient:
                                          resp.get("permanent")))
             if err == "forward_failed":
                 raise RemoteForwardFailed(resp.get("detail", ""))
+            if err == "insufficient_rights":
+                raise RemoteInsufficientRights(
+                    resp.get("detail", ""),
+                    int(resp.get("retry_after_ms", 100)))
             raise RemoteError(f"{err}: {resp.get('detail')}")
         return resp
 
@@ -440,6 +457,9 @@ class ApbClient:
                                     redirect=err["redirect"])
             if kind == "forward_failed":
                 raise RemoteForwardFailed(detail)
+            if kind == "insufficient_rights":
+                raise RemoteInsufficientRights(detail,
+                                               err["retry_after_ms"])
             raise RemoteError(f"{kind}: {detail}")
         hint = resp.get("ring_hint") if isinstance(resp, dict) else None
         if hint is not None:

@@ -46,6 +46,7 @@ from antidote_tpu.overload import (
     ColdMiss,
     DeadlineExceeded,
     ForwardFailed,
+    InsufficientRightsError,
     NotOwnerError,
     ReadOnlyError,
     ReplicaLagging,
@@ -59,6 +60,7 @@ from antidote_tpu.proto.client import (
     RemoteColdMiss,
     RemoteDeadline,
     RemoteError,
+    RemoteInsufficientRights,
     RemoteLagging,
     RemoteNotOwner,
     RemoteReadOnly,
@@ -100,6 +102,8 @@ def _rethrow(e: BaseException) -> None:
                              redirect=e.redirect) from e
     if isinstance(e, RemoteNotOwner):
         raise NotOwnerError(e.redirect) from e
+    if isinstance(e, RemoteInsufficientRights):
+        raise InsufficientRightsError(str(e), e.retry_after_ms) from e
     raise RuntimeError(str(e)) from e
 
 

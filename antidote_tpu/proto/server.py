@@ -34,6 +34,7 @@ from antidote_tpu.overload import (
     ColdMiss,
     DeadlineExceeded,
     ForwardFailed,
+    InsufficientRightsError,
     NotOwnerError,
     ReadOnlyError,
     ReplicaLagging,
@@ -498,6 +499,19 @@ class ProtocolServer:
                 conn_txns.discard(body.get("txid"))
             resp_code, resp = MessageCode.ERROR_RESP, {
                 "error": "aborted", "detail": str(e)
+            }
+        except InsufficientRightsError as e:
+            # escrow refusal (ISSUE 18): the counter_b decrement/transfer
+            # exceeded this DC's locally-held rights — nothing executed;
+            # the hint tracks the background transfer loop's expected
+            # grant arrival (a COMMIT refusal closed the txn server-side,
+            # so the descriptor must not linger in conn_txns)
+            if code in (MessageCode.UPDATE_OBJECTS,
+                        MessageCode.COMMIT_TRANSACTION):
+                conn_txns.discard(body.get("txid"))
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "insufficient_rights", "detail": str(e),
+                "retry_after_ms": int(e.retry_after_ms),
             }
         except BusyError as e:
             # downstream cap (commit backlog / batch gate): same typed

@@ -8,6 +8,15 @@ channel (:131-146), throttled per (key, target) by a grace period
 (?GRACE_PERIOD / ?TRANSFER_FREQ, /root/reference/include/antidote.hrl:73-79).
 The receiving side answers a transfer request by committing a
 ``("transfer", ...)`` update if it holds enough rights (:100-101).
+
+ISSUE 18 grows the seam into the escrow economy: refusal streaks per key
+feed retry hints (scaled by the expected grant arrival — the next
+background-transfer tick) and PROACTIVE rebalancing (a hot key under a
+sustained streak asks for headroom beyond the immediate shortfall, so
+grants land before the queue backs up).  Transfer requests ride the
+at-most-once inter-DC query channel: grants are non-idempotent commits,
+so a reply-phase failure surfaces typed and the grace throttle — set
+BEFORE the send — prevents a blind resend inside the window.
 """
 
 from __future__ import annotations
@@ -15,11 +24,24 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import numpy as np
+
 #: seconds a (key, target) pair is throttled after a transfer request
 #: (?GRACE_PERIOD in the reference is 1 s)
 GRACE_PERIOD = 1.0
 #: period of the background transfer loop (?TRANSFER_FREQ 100 ms)
 TRANSFER_FREQ = 0.1
+#: refusal streak at which the transfer loop starts asking for headroom
+#: beyond the immediate shortfall (proactive rebalancing)
+REBALANCE_STREAK = 2
+#: cap on the headroom multiplier a streak can earn (asks stay bounded
+#: by what each granter actually holds regardless)
+REBALANCE_MAX_FACTOR = 4
+#: ceiling on the client retry hint (ms) — even a deep streak should
+#: re-probe within a couple of transfer-loop periods of a grant landing
+HINT_CAP_MS = 2000
+#: refusal streaks with no activity for this long are forgotten
+STREAK_TTL = 10 * GRACE_PERIOD
 
 QueueKey = Tuple[Any, str]  # (key, bucket)
 
@@ -43,11 +65,26 @@ class BCounterManager:
         #: failed decrements awaiting rights: (key, bucket) -> rights NEEDED
         #: (the full decrement amount; the tick re-derives the shortfall
         #: from currently-held rights so arrived grants retire the entry)
+        # bounded-by: entries retire on grant arrival / satisfied() /
+        # bottom-state prune in transfer_periodic
         self.pending: Dict[QueueKey, int] = {}
         #: throttle map: ((key, bucket), target_dc) -> last request time
+        # bounded-by: entries older than GRACE_PERIOD are pruned every
+        # transfer_periodic tick (they carry no throttle information)
         self._last_request: Dict[Tuple[QueueKey, int], float] = {}
+        #: refusal streaks per key: (key, bucket) -> (streak, last seen);
+        #: the demand estimate behind retry hints + proactive rebalancing
+        # bounded-by: reset by satisfied(), pruned after STREAK_TTL of
+        # inactivity every transfer_periodic tick
+        self._refusals: Dict[QueueKey, Tuple[int, float]] = {}
         #: wired by the inter-DC layer: (target_dc, key, bucket, amount) -> None
         self.request_transfer: Optional[Callable[[int, Any, str, int], None]] = None
+        # escrow-economy odometers (node status / console ready line;
+        # the Prometheus twins live in obs.metrics and are bumped by the
+        # planes that own them)
+        self.refused_total = 0
+        self.requests_sent_total = 0
+        self.grants_arrived_total = 0
 
     # ------------------------------------------------------------------
     # decrement guard (generate_downstream, bcounter_mgr.erl:80-97)
@@ -57,9 +94,28 @@ class BCounterManager:
         replica does not hold ``amount`` rights for the object."""
         held = ty.local_rights(state, self.my_dc)
         if held < amount:
-            qk = (key, bucket)
-            self.pending[qk] = max(self.pending.get(qk, 0), amount)
+            self.note_refusal(key, bucket, amount)
             raise NoPermissionsError(key, amount, held)
+
+    def note_refusal(self, key, bucket: str, amount: int) -> int:
+        """Record a refused decrement: queue the shortfall for the
+        background transfer loop and deepen the key's refusal streak
+        (the per-key demand estimate).  Returns the new streak."""
+        qk = (key, bucket)
+        self.pending[qk] = max(self.pending.get(qk, 0), int(amount))
+        streak = self._refusals.get(qk, (0, 0.0))[0] + 1
+        self._refusals[qk] = (streak, self.clock())
+        self.refused_total += 1
+        return streak
+
+    def grant_hint_ms(self, key, bucket: str) -> int:
+        """Retry hint for a refused decrement, scaled by the expected
+        grant arrival: the background loop ticks every TRANSFER_FREQ, so
+        the first refusal retries after about one tick; a deeper streak
+        means rights are scarce fleet-wide — back off harder, capped so
+        clients re-probe soon after a grant could have landed."""
+        streak = self._refusals.get((key, bucket), (1, 0.0))[0]
+        return min(HINT_CAP_MS, int(TRANSFER_FREQ * 1e3) * (1 + streak))
 
     # ------------------------------------------------------------------
     # requester side (transfer_periodic, bcounter_mgr.erl:131-146)
@@ -67,21 +123,38 @@ class BCounterManager:
     def transfer_periodic(self, read_state: Callable[[Any, str], dict],
                           ty) -> int:
         """For each queued shortfall, ask the remote DCs holding the most
-        rights.  ``read_state`` returns the current counter_b state fields.
-        Returns the number of requests sent."""
+        rights.  ``read_state`` returns the current counter_b state fields
+        (None for a never-written object).  Returns the number of
+        requests sent."""
+        now = self.clock()
+        # prune the throttle map: an entry past the grace period carries
+        # no information (the throttle check would admit it anyway), and
+        # without pruning the map grows one entry per (key, target) ever
+        # asked, forever
+        for tk, t in list(self._last_request.items()):
+            if now - t >= GRACE_PERIOD:
+                del self._last_request[tk]
+        for qk, (streak, t) in list(self._refusals.items()):
+            if now - t >= STREAK_TTL and qk not in self.pending:
+                del self._refusals[qk]
         if self.request_transfer is None or not self.pending:
             return 0
-        import numpy as np
-
         sent = 0
-        now = self.clock()
         for (key, bucket), needed in list(self.pending.items()):
             state = read_state(key, bucket)
+            if state is None:
+                # bottom: the object was never written anywhere we can
+                # see, so no DC holds rights to grant — drop the entry
+                # (a later refusal against real state re-queues it)
+                del self.pending[(key, bucket)]
+                continue
             held = ty.local_rights(state, self.my_dc)
             shortfall = needed - max(held, 0)
             if shortfall <= 0:
                 # grants arrived: the queued decrement is now coverable
-                del self.pending[(key, bucket)]
+                # (clears the streak too — demand was met)
+                self.satisfied(key, bucket)
+                self.grants_arrived_total += 1
                 continue
             d = np.asarray(state["used"]).shape[0]
             rights_by_dc = sorted(
@@ -89,7 +162,14 @@ class BCounterManager:
                  if dc != self.my_dc),
                 reverse=True,
             )
-            remaining = shortfall
+            # proactive rebalancing: a sustained refusal streak is the
+            # demand signal — ask for headroom beyond the immediate
+            # shortfall so the next burst finds rights already here
+            streak = self._refusals.get((key, bucket), (0, 0.0))[0]
+            factor = 1
+            if streak >= REBALANCE_STREAK:
+                factor = min(REBALANCE_MAX_FACTOR, streak)
+            remaining = shortfall * factor
             for rights, dc in rights_by_dc:
                 if rights <= 0 or remaining <= 0:
                     break
@@ -97,16 +177,37 @@ class BCounterManager:
                 if now - self._last_request.get(tk, -1e9) < GRACE_PERIOD:
                     continue
                 ask = min(remaining, rights)
+                # throttle BEFORE the send: the query channel is
+                # at-most-once and grants are non-idempotent, so a
+                # reply-phase failure must NOT earn an immediate
+                # blind resend inside the grace window
                 self._last_request[tk] = now
                 self.request_transfer(dc, key, bucket, ask)
                 remaining -= ask
                 sent += 1
+                self.requests_sent_total += 1
         return sent
 
     def satisfied(self, key, bucket: str) -> None:
         """Drop the queue entry once rights arrived (caller observed a
         successful decrement or sufficient local rights)."""
         self.pending.pop((key, bucket), None)
+        self._refusals.pop((key, bucket), None)
+
+    def shortfall(self) -> int:
+        """Total rights currently queued for (the pending-shortfall
+        gauge's source)."""
+        return sum(self.pending.values())
+
+    def status(self) -> dict:
+        """Escrow block for node status / the console ready line."""
+        return {
+            "pending_keys": len(self.pending),
+            "shortfall": self.shortfall(),
+            "refused_total": self.refused_total,
+            "requests_sent_total": self.requests_sent_total,
+            "grants_arrived_total": self.grants_arrived_total,
+        }
 
     # ------------------------------------------------------------------
     # granter side (process_transfer, bcounter_mgr.erl:100-101)
@@ -117,17 +218,30 @@ class BCounterManager:
         transfer update; grants only what this replica holds.  Returns the
         granted amount (0 = refused)."""
         from antidote_tpu.crdt import get_type
+        from antidote_tpu.overload import InsufficientRightsError
 
         ty = get_type("counter_b")
-        state = txm.store.read_states(
-            [(key, "counter_b", bucket)], txm.store.dc_max_vc()
-        )[0]
+        # under the commit lock: this runs on the replica's RPC-serving
+        # thread, racing commits that may grow (reallocate) the device
+        # tables out from under an unsynchronized read
+        with txm.commit_lock:
+            state = txm.store.read_states(
+                [(key, "counter_b", bucket)], txm.store.dc_max_vc()
+            )[0]
+        if state is None:
+            return 0
         held = ty.local_rights(state, self.my_dc)
         grant = min(amount, held)
         if grant <= 0:
             return 0
-        txm.update_objects_static([
-            (key, "counter_b", bucket,
-             ("transfer", (grant, to_dc, self.my_dc))),
-        ])
+        try:
+            txm.update_objects_static([
+                (key, "counter_b", bucket,
+                 ("transfer", (grant, to_dc, self.my_dc))),
+            ])
+        except InsufficientRightsError:
+            # the read above raced a commit that spent the rights — the
+            # escrow certification refused the transfer, so nothing was
+            # granted (the requester's next tick may try elsewhere)
+            return 0
         return grant

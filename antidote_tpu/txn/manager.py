@@ -42,11 +42,12 @@ from antidote_tpu.crdt import TYPES, get_type, is_type
 from antidote_tpu.overload import (
     BusyError,
     DeadlineExceeded,
+    InsufficientRightsError,
     ReadOnlyError,
     check_deadline,
 )
 from antidote_tpu.store.kv import BoundObject, Effect, KVStore
-from antidote_tpu.txn.bcounter import BCounterManager, NoPermissionsError
+from antidote_tpu.txn.bcounter import BCounterManager
 from antidote_tpu.txn.hooks import HookRegistry
 
 log = logging.getLogger(__name__)
@@ -526,39 +527,32 @@ class TransactionManager:
         # lanes its downstream emits, e.g. mv observed ids) has the wider
         # tier's widths
         cfg_k = self.cfg
-        if ty.require_state_downstream(op) or guarded_b:
+        if ty.require_state_downstream(op):
             state = self._read_states_with_overlay(
                 [(key, type_name, bucket)], txn
             )[0]
             ent = self.store.locate(key, type_name, bucket, create=False)
             if ent is not None:
                 cfg_k = self.store.table(ent[0]).cfg
-        # escrow guard: counter_b decrements and outgoing transfers must be
-        # covered by locally held rights, and must act on THIS replica's
-        # lane — any other lane would spend rights this replica does not
-        # own (clocksi_downstream routes the bounded counter through
-        # bcounter_mgr, /root/reference/src/clocksi_downstream.erl:38-68)
+        # escrow lane guard: counter_b decrements and outgoing transfers
+        # must act on THIS replica's lane — any other lane would spend
+        # rights this replica does not own (clocksi_downstream routes the
+        # bounded counter through bcounter_mgr,
+        # /root/reference/src/clocksi_downstream.erl:38-68).  The RIGHTS
+        # check itself moved to commit time (ISSUE 18): the merged
+        # certification pass reserves rights once per key against a
+        # batch-local view instead of re-reading state per update here.
         if guarded_b:
             if op[0] == "decrement":
-                amount, lane = op[1]
-                src_lane = lane
+                _amount, src_lane = op[1]
             else:
-                amount, _to_dc, src_lane = op[1]
+                _amount, _to_dc, src_lane = op[1]
             if src_lane != self.my_dc:
                 self._mark_aborted(txn)
                 raise AbortError(
                     f"counter_b {op[0]} must spend this replica's lane "
                     f"{self.my_dc}, not {src_lane}"
                 )
-            try:
-                self.bcounters.check_decrement(ty, state, key, bucket, amount)
-            except NoPermissionsError as e:
-                if op[0] == "transfer":
-                    # transfers are not retried by the rights loop
-                    self.bcounters.satisfied(key, bucket)
-                self._mark_aborted(txn)
-                raise AbortError(str(e)) from e
-            self.bcounters.satisfied(key, bucket)
         seq = len(txn.pending_for(key, bucket))
         for eff_a, eff_b, blob_refs in ty.downstream(
             op, state, self.store.blobs, cfg_k
@@ -795,6 +789,52 @@ class TransactionManager:
                 ck = (eff.key, eff.bucket)
                 if ck not in last_seen:
                     last_seen[ck] = self.committed_keys.get(ck, 0)
+        # vectorized escrow certification (ISSUE 18): reserve counter_b
+        # rights ONCE per key for the whole merged batch — one state
+        # read per unique spend key instead of one per update, and a
+        # batch-local ledger serializes the members' spends (two txns
+        # racing the same last 5 rights: the first reserves, the second
+        # refuses typed).  Within a txn, spends net against its OWN
+        # own-lane increments (effects apply atomically) but a surplus
+        # never credits the batch ledger — a WAL-subgroup NACK of the
+        # crediting member would otherwise un-happen rights a sibling
+        # already spent (oversell).
+        esc_spends: Dict[int, Dict[tuple, Tuple[int, int]]] = {}
+        esc_avail: Dict[tuple, int] = {}
+        for txn in txns:
+            dec: Dict[tuple, int] = {}
+            spend: Dict[tuple, int] = {}
+            credit: Dict[tuple, int] = {}
+            for eff, op in txn.writeset:
+                if eff.type_name != "counter_b":
+                    continue
+                ck = (eff.key, eff.bucket)
+                if op[0] == "decrement":
+                    spend[ck] = spend.get(ck, 0) + int(op[1][0])
+                    dec[ck] = dec.get(ck, 0) + int(op[1][0])
+                elif op[0] == "transfer":
+                    spend[ck] = spend.get(ck, 0) + int(op[1][0])
+                elif op[0] == "increment" and op[1][1] == self.my_dc:
+                    credit[ck] = credit.get(ck, 0) + int(op[1][0])
+            net = {
+                ck: (max(0, n - credit.get(ck, 0)), dec.get(ck, 0))
+                for ck, n in spend.items()
+                if max(0, n - credit.get(ck, 0)) > 0
+            }
+            if net:
+                esc_spends[txn.txid] = net
+                for ck in net:
+                    esc_avail.setdefault(ck, 0)
+        if esc_avail:
+            ty_b = get_type("counter_b")
+            esc_keys = list(esc_avail)
+            states = self.store.read_states(
+                [(k, "counter_b", b) for k, b in esc_keys],
+                self.store.dc_max_vc(),
+            )
+            for ck, st in zip(esc_keys, states):
+                esc_avail[ck] = (0 if st is None
+                                 else int(ty_b.local_rights(st, self.my_dc)))
         for txn in txns:
             assert txn.active
             txn.active = False
@@ -831,6 +871,41 @@ class TransactionManager:
                     f"certification conflict on key {conflict!r}"
                 ))
                 continue
+            # escrow reservation against the batch-local rights ledger:
+            # a shortfall NACKs exactly this member (typed, with a hint
+            # scaled by the expected grant arrival) and feeds the
+            # background transfer loop's demand estimate
+            sp = esc_spends.get(txn.txid)
+            if sp is not None:
+                short = next(
+                    ((ck, n, d) for ck, (n, d) in sp.items()
+                     if n > esc_avail.get(ck, 0)), None)
+                if short is not None:
+                    (key, bucket), needed, dec_amt = short
+                    held = esc_avail.get((key, bucket), 0)
+                    if dec_amt > 0:
+                        self.bcounters.note_refusal(key, bucket, dec_amt)
+                    else:
+                        # refused outgoing transfers are not re-driven
+                        # by the rights loop (the requester's own loop
+                        # re-asks); they still count as refusals
+                        self.bcounters.refused_total += 1
+                    if self.metrics is not None:
+                        self.metrics.aborted_transactions.inc()
+                        self.metrics.escrow_refusals.inc()
+                        self.metrics.escrow_shortfall.set(
+                            self.bcounters.shortfall())
+                    out.append(InsufficientRightsError(
+                        f"insufficient rights for {key!r}: need "
+                        f"{needed}, hold {held}",
+                        retry_after_ms=self.bcounters.grant_hint_ms(
+                            key, bucket),
+                        key=key, needed=needed, held=held,
+                    ))
+                    continue
+                for ck, (n, _d) in sp.items():
+                    esc_avail[ck] -= n
+                    self.bcounters.satisfied(*ck)
             self.commit_counter += 1
             commit_vc = txn.snapshot_vc.copy()
             commit_vc[self.my_dc] = self.commit_counter

@@ -479,6 +479,8 @@ def _fanout_child(args) -> int:
 def _worker_child(args) -> int:
     if args.mode == "saturate":
         return _saturate_child(args)
+    if args.mode in ("flash", "flash_blind"):
+        return _flash_child(args)
     cfg = CONFIGS[args.config]
     op = _make_op(cfg["op"], args.keys, cfg["zipf"], args.read_frac)
     ops, lat_ms = _run_threads(args.host, args.port, op,
@@ -1590,6 +1592,302 @@ def bench_proxy_fanout(smoke: bool, assert_bounds: bool = False,
     return out
 
 
+# ---------------------------------------------------------------------------
+# flash sale: the escrow-economy storm (ISSUE 18)
+# ---------------------------------------------------------------------------
+#: flash-sale driver shape — FROZEN like the main configs.  Inventory is
+#: deliberately finite and split half/half across the two DCs' escrow
+#: lanes: the hot head of the Zipf keyspace MUST drain so the run
+#: exercises typed ``insufficient_rights`` refusals and background
+#: inter-DC rights transfers, while the long tail keeps acking — the
+#: goodput ratio against the blind-counter floor prices the whole
+#: escrow economy (certification, refusal round-trips, transfer
+#: traffic), not just the happy path.
+FLASH_SALE = {
+    "skus": 10_000, "smoke_skus": 200,
+    "inventory": 50, "smoke_inventory": 10,  # per SKU, across both lanes
+    "workers": 8, "smoke_workers": 4,        # threads per DC's child proc
+    "duration_s": 10.0, "smoke_duration_s": 2.0,
+    "mint_batch": 200,
+}
+
+
+def _flash_child(args) -> int:
+    """Flash-sale shopper worker: a closed loop of single-unit
+    ``decrement`` ops over a Zipf SKU keyspace against ONE DC.  In
+    ``flash`` mode the SKUs are bounded counters decremented on this
+    DC's escrow lane (``--lane``); a typed ``insufficient_rights``
+    refusal means *sold out here right now* — the shopper gives up on
+    that SKU and moves on (no blind retry: the refusal IS the product
+    working, and restocking the lane from the peer's surplus is the
+    background escrow loop's job, not the client's).  In
+    ``flash_blind`` mode the same storm hits plain ``counter_pn`` keys
+    that ack every decrement — the floor the escrow economy's goodput
+    is priced against."""
+    from antidote_tpu.proto.client import (AntidoteClient, RemoteAbort,
+                                           RemoteBusy,
+                                           RemoteInsufficientRights)
+
+    blind = args.mode == "flash_blind"
+    w = 1.0 / np.arange(1, args.keys + 1) ** 1.0
+    cdf = np.cumsum(w / w.sum())
+    stop = time.perf_counter() + args.duration
+    n = args.workers
+    acked = [0] * n
+    refused = [0] * n
+    busy = [0] * n
+    aborts = [0] * n
+    lats = [[] for _ in range(n)]
+    per_sku: list = [{} for _ in range(n)]
+    errs = []
+
+    def worker(i):
+        rng = np.random.default_rng(args.seed + i)
+        try:
+            c = AntidoteClient(args.host, args.port)
+            while time.perf_counter() < stop:
+                r = int(np.searchsorted(cdf, rng.random()))
+                if blind:
+                    upd = (f"fb{r}", "counter_pn", "b", ("decrement", 1))
+                else:
+                    upd = (f"fs{r}", "counter_b", "b",
+                           ("decrement", (1, args.lane)))
+                t0 = time.perf_counter()
+                try:
+                    c.update_objects([upd])
+                except RemoteInsufficientRights:
+                    refused[i] += 1
+                    continue
+                except RemoteBusy as e:
+                    busy[i] += 1
+                    time.sleep(min(e.retry_after_ms, 50.0) / 1e3)
+                    continue
+                except RemoteAbort:
+                    aborts[i] += 1
+                    continue
+                lats[i].append((time.perf_counter() - t0) * 1e3)
+                acked[i] += 1
+                if not blind:
+                    per_sku[i][r] = per_sku[i].get(r, 0) + 1
+            c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=args.duration + 60)
+    lat = [x for l in lats for x in l]
+    if len(lat) > 20_000:
+        idx = np.linspace(0, len(lat) - 1, 20_000).astype(int)
+        lat = list(np.asarray(lat)[idx])
+    sku_tot: dict = {}
+    for d in per_sku:
+        for r, k in d.items():
+            sku_tot[str(r)] = sku_tot.get(str(r), 0) + k
+    print(json.dumps({"acked": sum(acked), "refused": sum(refused),
+                      "busy": sum(busy), "aborts": sum(aborts),
+                      "per_sku": sku_tot, "lat_ms": lat, "errs": errs}))
+    return 0
+
+
+def _flash_phase(mode, infos, skus, workers, dur, seed):
+    """One storm phase: one shopper child process per DC (lane = dc),
+    results merged."""
+    procs = []
+    for dc, info in enumerate(infos):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker-child",
+             "--mode", mode, "--keys", str(skus), "--lane", str(dc),
+             "--host", info["host"], "--port", str(info["port"]),
+             "--workers", str(workers), "--duration", str(dur),
+             "--seed", str(seed + 111 * dc)],
+            env=_env(), stdout=subprocess.PIPE))
+    out = {"acked": 0, "refused": 0, "busy": 0, "aborts": 0,
+           "per_sku": {}, "lat_ms": [], "errs": []}
+    fails = []
+    for p in procs:
+        raw, _ = p.communicate(timeout=dur + 120)
+        if p.returncode != 0:
+            fails.append(p.returncode)
+            continue
+        d = json.loads(raw.decode().strip().splitlines()[-1])
+        for k in ("acked", "refused", "busy", "aborts"):
+            out[k] += d[k]
+        out["lat_ms"].extend(d["lat_ms"])
+        out["errs"].extend(d["errs"])
+        for r, cnt in d["per_sku"].items():
+            out["per_sku"][r] = out["per_sku"].get(r, 0) + cnt
+    assert not fails, f"flash children failed: {fails}"
+    return out
+
+
+def _flash_audit(cs, skus, inv, per_sku, timeout_s):
+    """Poll BOTH DCs until every SKU's converged value equals
+    ``inventory - acked`` (streams drained, transfers settled).  Run
+    AFTER the per-SKU oversell check, so a stuck stream surfaces as a
+    convergence timeout, not a phantom oversell."""
+    expect = {r: inv - int(per_sku.get(str(r), 0)) for r in range(skus)}
+    deadline = time.time() + timeout_s
+    bad = None
+    while time.time() < deadline:
+        bad = None
+        for dc, c in enumerate(cs):
+            for lo in range(0, skus, 200):
+                ks = list(range(lo, min(lo + 200, skus)))
+                vals, _ = c.read_objects([(f"fs{r}", "counter_b", "b")
+                                          for r in ks])
+                for r, v in zip(ks, vals):
+                    if v != expect[r]:
+                        bad = (dc, r, v, expect[r])
+                        break
+                if bad:
+                    break
+            if bad:
+                break
+        if bad is None:
+            return expect
+        time.sleep(0.25)
+    raise AssertionError(
+        f"flash-sale audit did not converge in {timeout_s}s: dc{bad[0]} "
+        f"reads sku {bad[1]} as {bad[2]}, expected {bad[3]} "
+        f"(inventory {inv})")
+
+
+def bench_flash_sale(smoke: bool, assert_bounds: bool, json_path=None):
+    """Two-DC escrow economy under a Zipf decrement storm (ISSUE 18).
+
+    Phases: mint (each DC funds its OWN lane, so sellers never wait on
+    replication for rights), blind floor (``counter_pn`` — every
+    decrement acks, no bound), escrow storm (``counter_b`` on the local
+    lane: typed refusals on drained lanes, background rights transfers
+    restocking them), then convergence + audit.
+
+    Gates (--assert-bounds, `make escrow-smoke`): ZERO oversell (no
+    SKU acks more than its inventory; every SKU's converged value ==
+    inventory - acked at BOTH DCs, hence >= 0), zero protocol errors,
+    nonzero typed refusals, and live transfer traffic (requests sent
+    AND requester-side grants).  Full runs additionally price goodput
+    against the blind floor (>= 0.5x — the ISSUE 18 acceptance bound)
+    and freeze BENCH_ESCROW_cpu.json; smoke runs never write."""
+    from antidote_tpu.proto.client import AntidoteClient
+
+    fs = FLASH_SALE
+    skus = fs["smoke_skus"] if smoke else fs["skus"]
+    inv = fs["smoke_inventory"] if smoke else fs["inventory"]
+    workers = fs["smoke_workers"] if smoke else fs["workers"]
+    dur = fs["smoke_duration_s"] if smoke else fs["duration_s"]
+    half = inv // 2
+    procs: list = []
+    cs: list = []
+    try:
+        infos = []
+        for dc in (0, 1):
+            ps, info = _spawn_server(
+                8, keys_hint=skus * 2,
+                extra=("--interdc", "--interdc-port", "0",
+                       "--dc-id", str(dc)))
+            procs += ps
+            infos.append(info)
+        # ready-line health: the supervised escrow loop must be armed
+        assert all(i.get("escrow", {}).get("loop") for i in infos), infos
+        cs = [AntidoteClient(i["host"], i["port"]) for i in infos]
+        descs = [c.get_connection_descriptor() for c in cs]
+        cs[0].connect_to_dcs([descs[1]])
+        cs[1].connect_to_dcs([descs[0]])
+        t0 = time.perf_counter()
+        for dc, c in enumerate(cs):
+            for lo in range(0, skus, fs["mint_batch"]):
+                c.update_objects([
+                    (f"fs{r}", "counter_b", "b", ("increment", (half, dc)))
+                    for r in range(lo, min(lo + fs["mint_batch"], skus))])
+        mint_s = round(time.perf_counter() - t0, 1)
+        blind = _flash_phase("flash_blind", infos, skus, workers, dur,
+                             seed=2000)
+        storm = _flash_phase("flash", infos, skus, workers, dur,
+                             seed=3000)
+        assert not blind["errs"] and not storm["errs"], (
+            blind["errs"], storm["errs"])
+        # zero oversell, checked from the CLIENTS' ledger first: no SKU
+        # may ack more units than were ever minted for it
+        over = {r: n for r, n in storm["per_sku"].items()
+                if n > 2 * half}
+        assert not over, f"OVERSELL: {sorted(over.items())[:5]}"
+        _flash_audit(cs, skus, 2 * half, storm["per_sku"],
+                     timeout_s=30.0 + skus / 200)
+        # transfer traffic: poll briefly — a grant rpc in flight when
+        # the storm ended still counts
+        esc = []
+        for _ in range(20):
+            esc = [c.node_status()["escrow"] for c in cs]
+            if sum(e["grants"].get("requester", 0) for e in esc):
+                break
+            time.sleep(0.25)
+        requests_sent = sum(e["requests_sent_total"] for e in esc)
+        grants: dict = {}
+        for e in esc:
+            for role, v in e["grants"].items():
+                grants[role] = grants.get(role, 0) + v
+        ratio = (round(storm["acked"] / blind["acked"], 3)
+                 if blind["acked"] else 0.0)
+        out = {
+            "skus": skus, "inventory_per_sku": 2 * half,
+            "workers": 2 * workers, "driver_procs": 2,
+            "duration_s": dur, "mint_s": mint_s,
+            "blind_acked_per_s": round(blind["acked"] / dur, 1),
+            "escrow_acked_per_s": round(storm["acked"] / dur, 1),
+            "goodput_ratio": ratio,
+            "acked": storm["acked"], "refused": storm["refused"],
+            "busy": storm["busy"] + blind["busy"],
+            "aborts": storm["aborts"] + blind["aborts"],
+            "skus_drained": sum(1 for n in storm["per_sku"].values()
+                                if n >= 2 * half),
+            "transfer": {"requests_sent": requests_sent,
+                         "grants": grants,
+                         "refused_total": sum(e["refused_total"]
+                                              for e in esc),
+                         "shortfall": sum(e["shortfall"] for e in esc)},
+            **_percentiles(storm["lat_ms"]),
+        }
+        print(json.dumps(out), flush=True)
+        if assert_bounds:
+            # structural gate (`make escrow-smoke`): the economy must
+            # have been EXERCISED, not just survived
+            assert storm["refused"] > 0, \
+                "no typed refusals — inventory never drained a lane"
+            assert requests_sent > 0 and grants.get("requester", 0) > 0, \
+                f"no transfer traffic: {esc}"
+        if not smoke:
+            assert ratio >= 0.5, (
+                f"escrow goodput {out['escrow_acked_per_s']}/s is below "
+                f"half the blind floor {out['blind_acked_per_s']}/s "
+                f"(ratio {ratio})")
+            if json_path:
+                doc = {"driver_rev": DRIVER_REV}
+                if os.path.exists(json_path):
+                    with open(json_path) as f:
+                        doc.update(json.load(f))
+                    doc["driver_rev"] = DRIVER_REV
+                doc["flash_sale"] = out
+                with open(json_path, "w") as f:
+                    json.dump(doc, f, indent=2)
+        return out
+    finally:
+        for c in cs:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -1637,6 +1935,16 @@ def main():
                          "zero session violations, nonzero forwarded "
                          "traffic — `make proxy-smoke`, never a "
                          "ratchet)")
+    ap.add_argument("--flash-sale", action="store_true",
+                    help="escrow economy bench (ISSUE 18): two --interdc "
+                         "DCs, Zipf flash-sale decrement storm over "
+                         "bounded counters vs a blind counter_pn floor; "
+                         "frozen under flash_sale in BENCH_ESCROW.  With "
+                         "--assert-bounds: structural gate (zero "
+                         "oversell, typed refusals seen, live transfer "
+                         "traffic — `make escrow-smoke`, never a "
+                         "ratchet); full runs also enforce the 0.5x "
+                         "goodput floor and freeze the artifact")
     ap.add_argument("--sockets", type=int, default=0, metavar="N",
                     help="socket-storm mode: open N concurrent "
                          "connections (>=1k exercises the native "
@@ -1657,7 +1965,10 @@ def main():
                     help="fanout-child: follower endpoints as "
                          "host:port,host:port,...")
     ap.add_argument("--mode", default="mixed",
-                    help="worker-child op mode: mixed | saturate")
+                    help="worker-child op mode: mixed | saturate | "
+                         "flash | flash_blind")
+    ap.add_argument("--lane", type=int, default=0,
+                    help="flash mode: this DC's escrow lane (= dc_id)")
     ap.add_argument("--keys", type=int, default=0)
     ap.add_argument("--read-frac", type=float, default=0.9)
     ap.add_argument("--rate", type=float, default=0.0,
@@ -1694,6 +2005,14 @@ def main():
             if not smoke else None
         bench_proxy_fanout(smoke, assert_bounds=args.assert_bounds,
                            json_path=path)
+        return 0
+    if args.flash_sale:
+        # same discipline as the other gates: smoke runs are the
+        # structural CI gate and never write; freezing BENCH_ESCROW is
+        # an explicit full run
+        path = (args.json or "BENCH_ESCROW_cpu.json") if not smoke else None
+        bench_flash_sale(smoke, assert_bounds=args.assert_bounds,
+                         json_path=path)
         return 0
     if args.sockets:
         out = bench_sockets(args.sockets, args.assert_bounds,

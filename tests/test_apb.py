@@ -451,6 +451,51 @@ def test_apb_bounded_counter_ops_carry_actor_lane():
         srv.close()
 
 
+def test_apb_bounded_counter_refusal_is_typed_and_retryable():
+    """Over-decrementing a counter_b surfaces the escrow refusal as a
+    typed ApbErrorResp (``insufficient_rights`` + retry hint in the
+    errmsg grammar, ISSUE 18) and leaves the connection and the value
+    intact — the client retries within rights on the same socket."""
+    node, srv = _mk_server()
+    try:
+        c = _ApbConn("127.0.0.1", srv.port)
+        name, resp = c.call("ApbStaticUpdateObjects", {
+            "transaction": {},
+            "updates": [{"boundobject": {"key": b"esc", "type": 15,
+                                         "bucket": b"b"},
+                         "operation": {"counterop": {"inc": 3}}}],
+        })
+        assert name == "ApbCommitResp" and resp["success"], resp
+        # decrement beyond rights: typed refusal, not a blind abort
+        name, resp = c.call("ApbStaticUpdateObjects", {
+            "transaction": {},
+            "updates": [{"boundobject": {"key": b"esc", "type": 15,
+                                         "bucket": b"b"},
+                         "operation": {"counterop": {"inc": -5}}}],
+        })
+        assert name == "ApbErrorResp", resp
+        err = apb.parse_error_text(resp["errmsg"])
+        assert err["kind"] == "insufficient_rights", err
+        assert err["retry_after_ms"] > 0
+        assert "need 5, hold 3" in err["detail"]
+        # connection stays usable; a covered decrement commits
+        name, resp = c.call("ApbStaticUpdateObjects", {
+            "transaction": {},
+            "updates": [{"boundobject": {"key": b"esc", "type": 15,
+                                         "bucket": b"b"},
+                         "operation": {"counterop": {"inc": -2}}}],
+        })
+        assert name == "ApbCommitResp" and resp["success"], resp
+        name, resp = c.call("ApbStaticReadObjects", {
+            "transaction": {"timestamp": resp["commit_time"]},
+            "objects": [{"key": b"esc", "type": 15, "bucket": b"b"}],
+        })
+        assert resp["objects"]["objects"][0]["counter"]["value"] == 1
+        c.close()
+    finally:
+        srv.close()
+
+
 def test_apb_commit_busy_keeps_descriptor_retryable():
     """A commit-backlog shed leaves the txn OPEN for retry in the native
     dialect; the apb dialect must match — popping the descriptor before

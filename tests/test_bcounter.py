@@ -8,6 +8,7 @@ import pytest
 from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.interdc import DCReplica, LoopbackHub
+from antidote_tpu.overload import InsufficientRightsError
 from antidote_tpu.txn.manager import AbortError
 
 
@@ -39,7 +40,7 @@ def test_decrement_within_rights(dcs):
 def test_decrement_beyond_rights_aborts(dcs):
     hub, nodes, _ = dcs
     nodes[0].update_objects([("c", "counter_b", "b", ("increment", (3, 0)))])
-    with pytest.raises(AbortError, match="insufficient rights"):
+    with pytest.raises(InsufficientRightsError, match="insufficient rights"):
         nodes[0].update_objects([("c", "counter_b", "b", ("decrement", (5, 0)))])
     # value untouched; the needed amount is queued for the transfer loop
     vals, _ = nodes[0].read_objects([("c", "counter_b", "b")])
@@ -56,7 +57,7 @@ def test_transfer_loop_moves_rights_between_dcs(dcs):
     # DC1 sees the value but holds no rights
     vals, _ = nodes[1].read_objects([("c", "counter_b", "b")], clock=vc)
     assert vals[0] == 10
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
     # transfer loop: DC1 asks DC0 (the richest lane); DC0 commits a
     # transfer; replication delivers it back to DC1
@@ -78,14 +79,14 @@ def test_transfer_request_throttled_by_grace_period(dcs):
     nodes[1].txm.bcounters.clock = lambda: t[0]
     nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
     hub.pump()
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (20, 1)))])
     # drop the granted transfer so the shortfall persists
     hub.drop_next(0, 1, n=10)
     assert reps[1].bcounter_tick() == 1
     hub.pump()
     # same instant: throttled, no second request
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (20, 1)))])
     assert reps[1].bcounter_tick() == 0
     # after the grace period the request is retried
@@ -131,7 +132,7 @@ def test_client_transfer_requires_local_rights(dcs):
             [("c", "counter_b", "b", ("transfer", (5, 1, 0)))], clock=vc
         )
     # over-transfer from own (empty) lane
-    with pytest.raises(AbortError, match="insufficient rights"):
+    with pytest.raises(InsufficientRightsError, match="insufficient rights"):
         nodes[1].update_objects(
             [("c", "counter_b", "b", ("transfer", (1, 0, 1)))], clock=vc
         )
@@ -147,7 +148,7 @@ def test_transfer_queue_retires_when_rights_arrive(dcs):
     hub, nodes, reps = dcs
     nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
     hub.pump()
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
     assert reps[1].bcounter_tick() == 1   # request sent, grant replicates
     hub.pump()
@@ -174,9 +175,127 @@ def test_concurrent_decrements_never_go_negative(dcs):
         vals, _ = n.read_objects([("c", "counter_b", "b")],
                                  clock=n.txm.store.dc_max_vc())
         assert vals[0] == 0
-    # both replicas are now dry: further decrements abort everywhere
+    # both replicas are now dry: further decrements refuse everywhere
     for i in (0, 1):
-        with pytest.raises(AbortError):
+        with pytest.raises(InsufficientRightsError):
             nodes[i].update_objects(
                 [("c", "counter_b", "b", ("decrement", (1, i)))]
             )
+
+
+def test_refusal_streak_scales_hint_and_rebalance(dcs):
+    """Repeated refusals on the same key build a streak: the retry hint
+    grows with it and the transfer loop over-asks (proactive rebalance)
+    once the streak crosses the threshold."""
+    from antidote_tpu.txn import bcounter as bc
+    hub, nodes, reps = dcs
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (100, 0)))])
+    hub.pump()
+    mgr = nodes[1].txm.bcounters
+    base = int(bc.TRANSFER_FREQ * 1e3)
+    for streak in (1, 2, 3):
+        with pytest.raises(InsufficientRightsError) as ei:
+            nodes[1].update_objects(
+                [("c", "counter_b", "b", ("decrement", (5, 1)))]
+            )
+        assert ei.value.retry_after_ms == min(
+            bc.HINT_CAP_MS, base * (1 + streak)
+        )
+    # streak 3 >= REBALANCE_STREAK: the request over-asks by the factor
+    captured = []
+    mgr.request_transfer = lambda dc, key, bucket, n: captured.append(n)
+    reps[1].bcounter_tick()
+    assert captured == [5 * min(bc.REBALANCE_MAX_FACTOR, 3)]
+    assert mgr.refused_total == 3
+    assert mgr.requests_sent_total == 1
+
+
+def test_refusal_state_prunes_and_status(dcs):
+    """_last_request and stale streaks are pruned each tick; status()
+    reports the live escrow picture (bounded observability)."""
+    from antidote_tpu.txn import bcounter as bc
+    hub, nodes, reps = dcs
+    t = [0.0]
+    mgr = nodes[1].txm.bcounters
+    mgr.clock = lambda: t[0]
+    nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    with pytest.raises(InsufficientRightsError):
+        nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
+    st = mgr.status()
+    assert st["pending_keys"] == 1 and st["shortfall"] == 4
+    assert st["refused_total"] == 1
+    assert reps[1].bcounter_tick() == 1
+    assert (("c", "b"), 0) in mgr._last_request
+    hub.pump()
+    # grant landed: the next tick retires the entry and its streak
+    assert reps[1].bcounter_tick() == 0
+    assert mgr.pending == {} and mgr._refusals == {}
+    # throttle entries older than the grace period are pruned
+    t[0] += bc.GRACE_PERIOD + 0.1
+    reps[1].bcounter_tick()
+    assert mgr._last_request == {}
+    assert mgr.status()["shortfall"] == 0
+
+
+def test_rights_conservation_under_seeded_interleavings(dcs):
+    """Property: across seeded transfer/decrement interleavings the
+    global invariant holds at every converged point — value equals total
+    increments minus total successful decrements, never negative, and
+    rights are conserved (transfers move, never mint)."""
+    import random
+
+    hub, nodes, reps = dcs
+    for seed in (1, 7, 42):
+        rng = random.Random(seed)
+        key = f"inv{seed}"
+        total = 60
+        nodes[0].update_objects(
+            [("c", "counter_b", key, ("increment", (total, 0)))]
+        )
+        hub.pump()
+        sold = 0
+        for step in range(30):
+            dc = rng.randrange(2)
+            n = rng.randint(1, 5)
+            action = rng.random()
+            try:
+                if action < 0.6:
+                    nodes[dc].update_objects(
+                        [("c", "counter_b", key, ("decrement", (n, dc)))]
+                    )
+                    sold += n
+                else:
+                    to = 1 - dc
+                    nodes[dc].update_objects(
+                        [("c", "counter_b", key, ("transfer", (n, to, dc)))]
+                    )
+            except InsufficientRightsError:
+                pass
+            if rng.random() < 0.3:
+                hub.pump()
+                for r in reps:
+                    r.bcounter_tick()
+        hub.pump()
+        for r in reps:
+            r.bcounter_tick()
+        hub.pump()
+        assert sold <= total
+        vc = nodes[0].txm.store.dc_max_vc()
+        for n_ in nodes:
+            vals, _ = n_.read_objects([("c", "counter_b", key)], clock=vc)
+            assert vals[0] == total - sold
+            assert vals[0] >= 0
+        # conservation: transfers move rights, never mint — the per-lane
+        # holdings always sum to the value, and the mint total (diagonal)
+        # never changes
+        import numpy as np
+
+        from antidote_tpu.crdt import get_type
+
+        ty = get_type("counter_b")
+        st = nodes[0].txm.store.read_states([("c", "counter_b", key)], vc)[0]
+        d = np.asarray(st["used"]).shape[0]
+        assert sum(ty.local_rights(st, dc) for dc in range(d)) == total - sold
+        assert int(np.trace(np.asarray(st["rights"]))) == total
+        assert all(ty.local_rights(st, dc) >= 0 for dc in range(d))

@@ -1856,6 +1856,312 @@ def test_proxy_fabric_sigkill_target_serverside_failover(tmp_path):
                 pass
 
 
+# ---------------------------------------------------------------------------
+# scenario 19: the escrow economy under partition + crash (ISSUE 18) —
+# a Zipf-contended 2-DC flash sale over bounded counters.  Sever the
+# link mid-sale: each side keeps selling its OWN escrow, then refuses
+# typed (insufficient_rights with a retry hint) — never oversells.
+# SIGKILL the granter mid-transfer (the grant window stretched by an
+# env-armed ``bcounter.transfer`` delay), respawn it from its WAL, and
+# heal: the supervised rights-transfer loop survives every failure
+# typed (no blind resend on the at-most-once query channel), grants
+# resume, and both DCs converge to the exact global inventory —
+# oversell == 0, acked sales all survive, rights conserved per lane.
+# ---------------------------------------------------------------------------
+def test_flash_sale_partition_and_granter_crash_never_oversells(tmp_path):
+    import json
+    import os
+    import random
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.overload import InsufficientRightsError
+    from antidote_tpu.proto.client import (AntidoteClient, RemoteAbort,
+                                           RemoteBusy,
+                                           RemoteInsufficientRights)
+    from antidote_tpu.txn.manager import AbortError
+
+    rcfg = AntidoteConfig(n_shards=2, max_dcs=2, wal_segments=3)
+    log_dir = str(tmp_path / "wal-dc0")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        # stretch every grant DC0 serves so the SIGKILL below lands
+        # mid-transfer deterministically
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 19, "rules": [
+            {"site": "bcounter.transfer", "action": "delay",
+             "arg": 0.35}]}),
+    )
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--shards", "2", "--max-dcs", "2",
+             "--log-dir", log_dir, "--sync-log", "--wal-segments", "3",
+             "--interdc", "--interdc-port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+
+    skus = ["sku0", "sku1", "sku2"]
+    inv = {"sku0": 40, "sku1": 24, "sku2": 16}
+    restock = {"sku0": 20, "sku1": 10, "sku2": 6}
+    weights = [8, 3, 1]  # Zipf-ish contention: sku0 is the hot item
+    acked = {s: 0 for s in skus}     # committed-and-acked sales
+    lost = {s: 0 for s in skus}      # in-flight at a socket death
+    refused = [0, 0]                 # typed refusals per DC
+    aborts = [0]                     # cert conflicts (retried, not sold)
+    errs: list = []                  # anything NOT typed = protocol error
+    acct = threading.Lock()
+    stop = threading.Event()
+
+    proc = spawn()
+    peer = peer_rep = peer_fabric = loop = None
+    pump_stop = threading.Event()
+    pump_th = None
+    sellers = []
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        assert info.get("escrow", {}).get("loop") is True  # console wired
+        # in-process DC1 on its own fabric, subscribed both ways
+        peer_fabric = TcpFabric(backoff_base=0.05, backoff_max=0.5)
+        peer = AntidoteNode(rcfg, dc_id=1)
+        peer_rep = DCReplica(peer, peer_fabric, "dc1")
+        c0 = AntidoteClient(info["host"], info["port"])
+        peer_rep.observe_descriptor(c0.get_connection_descriptor())
+        c0.connect_to_dcs([peer_rep.descriptor().to_wire()])
+        # mint the opening inventory at DC0 (all rights on lane 0)
+        for s in skus:
+            c0.update_objects([(s, "counter_b", "b",
+                                ("increment", (inv[s], 0)))])
+        c0.close()
+
+        def pumper():
+            while not pump_stop.is_set():
+                try:
+                    peer_fabric.pump(timeout=0.05)
+                except OSError:
+                    time.sleep(0.02)
+
+        pump_th = threading.Thread(target=pumper)
+        pump_th.start()
+        # the tentpole under test: the SUPERVISED background transfer
+        # loop drives DC1's side of the escrow economy
+        loop = peer_rep.start_escrow_loop()
+        mgr = peer.txm.bcounters
+
+        def sell_dc0(seed):
+            """Wire seller against DC0; exits when the kill severs it."""
+            rng = random.Random(seed)
+            c = AntidoteClient(info["host"], info["port"])
+            try:
+                while not stop.is_set():
+                    s = rng.choices(skus, weights)[0]
+                    try:
+                        c.update_objects(
+                            [(s, "counter_b", "b", ("decrement", (1, 0)))])
+                        with acct:
+                            acked[s] += 1
+                    except RemoteInsufficientRights as e:
+                        with acct:
+                            refused[0] += 1
+                        assert e.retry_after_ms > 0
+                        time.sleep(min(e.retry_after_ms, 250) / 1e3)
+                    except (RemoteBusy, RemoteAbort):
+                        with acct:
+                            aborts[0] += 1
+                        time.sleep(0.01)
+                    except (ConnectionError, OSError):
+                        with acct:
+                            lost[s] += 1  # outcome unknown: the kill
+                        return
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+            finally:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+        def sell_dc1(seed):
+            """In-process seller on DC1's own lane — its rights arrive
+            only through the transfer loop's grants."""
+            rng = random.Random(seed)
+            try:
+                while not stop.is_set():
+                    s = rng.choices(skus, weights)[0]
+                    try:
+                        peer.update_objects(
+                            [(s, "counter_b", "b", ("decrement", (1, 1)))])
+                        with acct:
+                            acked[s] += 1
+                    except InsufficientRightsError as e:
+                        with acct:
+                            refused[1] += 1
+                        assert e.retry_after_ms > 0
+                        time.sleep(min(e.retry_after_ms, 250) / 1e3)
+                    except AbortError:
+                        with acct:
+                            aborts[0] += 1
+                        time.sleep(0.01)
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        sellers = [threading.Thread(target=sell_dc0, args=(100 + i,))
+                   for i in range(2)]
+        sellers += [threading.Thread(target=sell_dc1, args=(200 + i,))
+                    for i in range(2)]
+        for t in sellers:
+            t.start()
+        # -- phase 1: open sale — both DCs sell; DC1 starts with ZERO
+        # rights, so any DC1 sale proves a grant crossed the wire
+        deadline = time.monotonic() + 90.0
+        while True:
+            with acct:
+                dc1_sold = mgr.grants_arrived_total
+                total = sum(acked.values())
+            if dc1_sold >= 1 and total >= 8 and refused[1] >= 1:
+                break
+            assert time.monotonic() < deadline, (
+                f"open sale stalled: acked={acked} refused={refused} "
+                f"escrow={mgr.status()}")
+            assert not errs, errs
+            time.sleep(0.05)
+        # -- phase 2: sever mid-sale.  No grants can cross; each side
+        # drains its OWN escrow then refuses typed — zero oversell
+        inj = faults.install(faults.FaultPlan(seed=19))
+        inj.sever(0, 1)
+        with acct:
+            r0, r1 = refused
+        deadline = time.monotonic() + 60.0
+        while True:
+            with acct:
+                if refused[0] > r0 and refused[1] > r1 + 1:
+                    break
+            assert time.monotonic() < deadline, (
+                f"partitioned sides never went dry+typed: "
+                f"refused={refused} (was {r0},{r1}) acked={acked}")
+            assert not errs, errs
+            time.sleep(0.05)
+        # restock DC0 while partitioned (the second drop): this is the
+        # escrow the post-heal grant — and the mid-transfer kill — rides
+        cr = AntidoteClient(info["host"], info["port"])
+        for s in skus:
+            cr.update_objects([(s, "counter_b", "b",
+                                ("increment", (restock[s], 0)))])
+        cr.close()
+        # -- phase 3: heal, then SIGKILL the granter mid-transfer.  The
+        # env-armed delay holds DC0's grant open 0.35s; we kill inside
+        # that window, right after DC1's loop sends a request
+        inj.heal_all()
+        rs0 = mgr.requests_sent_total
+        deadline = time.monotonic() + 30.0
+        while mgr.requests_sent_total <= rs0:
+            assert time.monotonic() < deadline, (
+                f"no post-heal transfer request: {mgr.status()}")
+            time.sleep(0.01)
+        time.sleep(0.15)  # inside the stretched grant window
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        # the supervised loop survived the mid-transfer death typed
+        time.sleep(0.5)
+        assert loop.crashed is None, f"escrow loop crashed: {loop.crashed}"
+        assert loop.is_alive()
+        # -- phase 4: respawn DC0 from its WAL, rewire, finish the sale
+        proc2 = spawn()
+        proc = proc2
+        info2 = json.loads(proc2.stdout.readline())
+        assert info2["ready"] is True
+        c0 = AntidoteClient(info2["host"], info2["port"])
+        peer_rep.observe_descriptor(c0.get_connection_descriptor())
+        c0.connect_to_dcs([peer_rep.descriptor().to_wire()])
+        c0.close()
+        info = info2
+        with acct:
+            sold_at_respawn = sum(acked.values())
+        deadline = time.monotonic() + 90.0
+        while True:
+            with acct:
+                if sum(acked.values()) >= sold_at_respawn + 2:
+                    break  # the grant economy resumed post-crash
+            assert time.monotonic() < deadline, (
+                f"no sales after respawn: acked={acked} "
+                f"escrow={mgr.status()}")
+            assert not errs, errs
+            time.sleep(0.05)
+        stop.set()
+        for t in sellers:
+            t.join(timeout=30)
+        sellers = []
+        assert not errs, errs
+        # -- phase 5: convergence + the escrow ledger.  Both DCs settle
+        # to IDENTICAL values at the joint clock; every SKU accounts
+        # exactly: sold ⊆ acked-or-lost, oversell == 0, rights conserved
+        from antidote_tpu.crdt import get_type
+
+        ty = get_type("counter_b")
+        total_inv = {s: inv[s] + restock[s] for s in skus}
+        objs = [(s, "counter_b", "b") for s in skus]
+        cv = AntidoteClient(info["host"], info["port"])
+        deadline = time.monotonic() + 90.0
+        while True:
+            with peer.txm.commit_lock:
+                vc1 = peer.txm.store.dc_max_vc()
+                v1, _ = peer.read_objects(objs, clock=vc1)
+            try:
+                v0, _ = cv.read_objects(objs,
+                                        clock=[int(x) for x in vc1])
+            except Exception:
+                v0 = None  # DC0 still catching up to DC1's lane
+            if v0 == v1:
+                break
+            assert time.monotonic() < deadline, (
+                f"DCs never converged: dc0={v0} dc1={v1}")
+            time.sleep(0.2)
+        cv.close()
+        with acct:
+            for i, s in enumerate(skus):
+                committed = total_inv[s] - v1[i]
+                assert v1[i] >= 0, f"{s}: OVERSOLD to {v1[i]}"
+                assert acked[s] <= committed <= acked[s] + lost[s], (
+                    f"{s}: acked={acked[s]} committed={committed} "
+                    f"lost={lost[s]}")
+        # rights conservation per SKU: the mint total (diagonal) is the
+        # exact global inventory; per-lane holdings sum to the value and
+        # no lane ever went negative — transfers moved, never minted
+        with peer.txm.commit_lock:
+            states = peer.txm.store.read_states(objs, vc1)
+        for i, s in enumerate(skus):
+            st = states[i]
+            d = np.asarray(st["used"]).shape[0]
+            assert int(np.trace(np.asarray(st["rights"]))) == total_inv[s]
+            assert sum(ty.local_rights(st, dc) for dc in range(d)) == v1[i]
+            assert all(ty.local_rights(st, dc) >= 0 for dc in range(d))
+        # the economy's paper trail: typed refusals on both sides, a
+        # failed (killed/severed) grant that was never blind-resent,
+        # and successful requester-side grants
+        assert refused[0] >= 1 and refused[1] >= 2, refused
+        m = peer.metrics
+        assert m.escrow_grants.value(role="requester") >= 1
+        assert m.escrow_grants.value(role="failed") >= 1
+        assert mgr.grants_arrived_total >= 1
+    finally:
+        stop.set()
+        for t in sellers:
+            t.join(timeout=30)
+        if loop is not None:
+            loop.stop()
+        pump_stop.set()
+        if pump_th is not None:
+            pump_th.join(timeout=10)
+        if peer_fabric is not None:
+            peer_fabric.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 @pytest.mark.slow
 def test_storm_soak_many_rounds(cfg):
     """A longer seeded storm across 3 DCs with partitions opening and

@@ -219,6 +219,18 @@ def test_frame_fuzz_corpus_parity():
         corpus.append(("valid-read-miss", _raw_frame(
             MessageCode.STATIC_READ_OBJECTS,
             {"objects": [["nope", "counter_pn", "b"]], "clock": None})))
+        # -- counter_b frames (ISSUE 18): a valid escrow mint, then a
+        #    decrement beyond rights — the typed insufficient_rights
+        #    refusal (kind, detail, retry hint) must be byte-identical
+        #    across both accept planes
+        corpus.append(("bcounter-mint", _raw_frame(
+            MessageCode.STATIC_UPDATE_OBJECTS,
+            {"updates": [["bz", "counter_b", "b", ["increment", [3, 0]]]],
+             "clock": None})))
+        corpus.append(("bcounter-overdraw", _raw_frame(
+            MessageCode.STATIC_UPDATE_OBJECTS,
+            {"updates": [["bz", "counter_b", "b", ["decrement", [9, 0]]]],
+             "clock": None})))
         # -- garbage msgpack bodies behind a valid header + code byte:
         #    typed ERROR_RESP (decode exception name), conn kept
         for i in range(6):

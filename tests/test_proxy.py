@@ -28,6 +28,7 @@ from antidote_tpu.interdc.tcp import TcpFabric
 from antidote_tpu.obs.metrics import NodeMetrics
 from antidote_tpu.overload import DeadlineExceeded
 from antidote_tpu.proto.client import (AntidoteClient, ApbClient,
+                                       RemoteInsufficientRights,
                                        RemoteLagging, RemoteNotOwner,
                                        SessionClient)
 from antidote_tpu.proto.proxy import FleetHealth, ProxyPlane
@@ -444,4 +445,42 @@ def test_no_server_proxy_opt_out_preserves_typed_vocabulary(cfg,
         with pytest.raises(RemoteLagging) as ei:
             fc.read_objects([("k", "counter_pn", "b")], clock=ahead)
         assert ei.value.retry_after_ms > 0
+        fc.close()
+
+
+def test_forwarded_bcounter_refusal_is_typed_and_at_most_once(cfg,
+                                                              tmp_path):
+    """ISSUE 18: a counter_b decrement through a ring-oblivious follower
+    forwards to the owner; an escrow shortfall comes back as the typed
+    ``insufficient_rights`` refusal (retry hint intact) with EXACTLY one
+    forwarded attempt — the proxy never blind-resends a refused spend —
+    and a covered decrement on the same socket commits."""
+    with _cluster(cfg, tmp_path, followers=1) as cl:
+        f1 = cl["fs"][0]
+        fc = AntidoteClient(f1["srv"].host, f1["srv"].port)
+        vc = fc.update_objects([("sku", "counter_b", "b",
+                                 ("increment", (3, 0)))])
+        base = fc.node_status()["pipeline"]["proxy"]["forwarded"]["write"]
+        with pytest.raises(RemoteInsufficientRights) as ei:
+            fc.update_objects([("sku", "counter_b", "b",
+                                ("decrement", (5, 0)))], clock=vc)
+        assert ei.value.retry_after_ms > 0
+        assert "need 5, hold 3" in str(ei.value)
+        st = fc.node_status()["pipeline"]["proxy"]
+        # at-most-once: one client call, one forwarded attempt — the
+        # refusal surfaced instead of being retried into an oversell
+        assert st["forwarded"]["write"] == base + 1
+        assert cl["owner"].txm.bcounters.refused_total == 1
+        # the owner queued the shortfall for its transfer loop
+        assert cl["owner"].txm.bcounters.shortfall() == 5
+        # a covered decrement on the same socket commits and retires
+        # nothing it shouldn't (value 3-2=1)
+        vc = fc.update_objects([("sku", "counter_b", "b",
+                                ("decrement", (2, 0)))], clock=vc)
+        vals, _ = fc.read_objects([("sku", "counter_b", "b")], clock=vc)
+        assert vals == [1]
+        # escrow block rides node status (the refusal was the owner's)
+        esc = cl["owner"].status()["escrow"]
+        assert esc["refused_total"] == 1
+        assert "escrow" in fc.node_status()  # surfaced on the wire too
         fc.close()
