@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke proxy-smoke escrow-smoke mesh-smoke hotkey-smoke native native-check socket-storm lint bench bench-wire multichip all
+.PHONY: test smoke chaos saturation perf-smoke restart-smoke coldtier-smoke replica-smoke fleet-smoke proxy-smoke escrow-smoke mesh-smoke hotkey-smoke tenant-smoke native native-check socket-storm lint bench bench-wire multichip all
 
 all: lint smoke
 
@@ -144,6 +144,18 @@ mesh-smoke:
 hotkey-smoke:
 	$(PY) -m pytest tests/test_fold_parity.py -q
 	$(PY) tools/bench_hotkey.py --smoke --assert-bounds
+
+# multi-tenant QoS (ISSUE 19): the WFQ/quota/identity property suite
+# (DRR shares, work conservation, per-key retry streaks, typed
+# tenant_busy end-to-end over both dialects incl. a forwarding
+# follower) plus one live aggressor+victim storm at a 4:1 weight
+# ratio.  The gate is STRUCTURAL only: the aggressor's quota actually
+# trips, the victim sees ZERO typed refusals, both tenants progress;
+# the frozen inflation/share curves in BENCH_TENANT_cpu.json are never
+# a CI ratchet (2-core container — see its host_note)
+tenant-smoke:
+	$(PY) -m pytest tests/test_tenancy.py -q
+	$(PY) bench_wire.py --tenants --smoke --assert-bounds
 
 # fast fundamental tier, <90s: clocks, router, WAL, metadata, txn layer,
 # wire codecs, store tables, observability, console, supervision

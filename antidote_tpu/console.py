@@ -248,10 +248,15 @@ def cmd_serve(args) -> int:
         )
     server_box = {}
 
+    from antidote_tpu.tenancy import TenantRegistry
+
+    tenants = TenantRegistry.from_flags(getattr(args, "tenant", None))
+
     def start_proto():
         port = server_box["srv"].port if "srv" in server_box else args.port
         server_box["srv"] = ProtocolServer(
             node, host=args.host, port=port, interdc=interdc,
+            tenants=tenants,
             max_connections=args.max_connections,
             max_in_flight=args.max_in_flight,
             max_in_flight_per_client=args.max_in_flight_per_client,
@@ -282,6 +287,8 @@ def cmd_serve(args) -> int:
     sup.start()
     server = server_box["srv"]
     ready: dict = {"host": server.host, "port": server.port, "ready": True}
+    if tenants.multi:
+        ready["tenants"] = list(tenants.names)
     if follower is not None:
         # attach AFTER the fabric pump + server are supervised: the
         # bootstrap ships the fleet's images, catches the tails up, then
@@ -759,6 +766,21 @@ def main(argv=None) -> int:
                     help="published checkpoint images kept on disk; "
                          "older ones (and WAL files wholly below the "
                          "newest floor) are reclaimed after each publish")
+    sv.add_argument("--tenant", action="append", default=None,
+                    metavar="NAME:WEIGHT[,max_in_flight=N][,max_backlog=N]",
+                    help="declare a tenant lane for weighted-fair "
+                         "admission (repeatable; ISSUE 19).  Requests "
+                         "map to the lane whose name prefixes their "
+                         "bucket as 'tenant/bucket' (or carry an "
+                         "explicit per-request tag); everything else "
+                         "rides the built-in 'default' lane.  WEIGHT "
+                         "sets the lane's deficit-round-robin share; "
+                         "max_in_flight caps the tenant's admitted "
+                         "requests, max_backlog its queued depth "
+                         "(defaults: weight-proportional slice of the "
+                         "shared bound).  Over-quota requests get a "
+                         "typed tenant_busy refusal while other lanes "
+                         "keep serving")
     sv.add_argument("--group-commit-window-us", type=float, default=0.0,
                     help="merge-point gather window in µs: the locked "
                          "worker keeps draining late-arriving commits "

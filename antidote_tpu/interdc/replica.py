@@ -148,6 +148,9 @@ class DCReplica:
         node.txm.on_clock_wait = self._on_clock_wait
         # bcounter rights requests ride the query channel (?BCOUNTER_REQUEST)
         node.txm.bcounters.request_transfer = self._request_transfer
+        # batched twin (ISSUE 19): one tick's asks against the same
+        # granter share ONE round trip
+        node.txm.bcounters.request_transfer_many = self._request_transfer_many
         #: clustered DCs install an intra-DC router here (attach_interdc)
         self.transfer_handler = None
         #: follower registry (ISSUE 9): name -> {addr, applied, state,
@@ -564,40 +567,26 @@ class DCReplica:
         """Generic query-channel dispatch (inter_dc_query_receive_socket,
         /root/reference/src/inter_dc_query_receive_socket.erl:111-139)."""
         if kind == "bcounter":
-            # fault site ``bcounter.transfer``: consulted at granter
-            # entry, so chaos plans can starve (drop/error) or stretch
-            # (delay — wide enough to SIGKILL a granter mid-transfer)
-            # grant traffic like every other plane
-            import errno as _errno
-
-            from antidote_tpu import faults as _faults
-
-            d = _faults.hit("bcounter.transfer",
-                            key=(payload.get("key"), self.dc_id))
-            if d is not None:
-                if d.action == "delay" and d.arg:
-                    time.sleep(float(d.arg))
-                elif d.action == "drop":
-                    raise ConnectionError(
-                        "injected fault: bcounter.transfer dropped")
-                elif d.action in ("error", "io_error", "enospc"):
-                    raise OSError(
-                        _errno.EIO,
-                        f"injected fault: bcounter.transfer "
-                        f"{payload.get('key')!r}")
-            if self.transfer_handler is not None:
-                # clustered DC: route to the key's owner member, whose
-                # coordinator commits the grant through the sequencer
-                grant = self.transfer_handler(payload)
-            else:
-                grant = self.node.txm.bcounters.process_transfer(
-                    self.node.txm, payload["key"], payload["bucket"],
-                    payload["amount"], payload["to_dc"],
-                )
-            m = getattr(self.node, "metrics", None)
-            if grant and m is not None:
-                m.escrow_grants.inc(role="granter")
-            return grant
+            return self._grant_one(payload)
+        if kind == "bcounter_many":
+            # batched rights requests (ISSUE 19): each entry keeps the
+            # single-key grant discipline — the SAME fault site keyed
+            # per key (a plan can starve one key of the batch), the
+            # same clustered routing, the same granter counter.  Per-
+            # entry faults fail THAT entry (grant 0), not the frame:
+            # the requester's at-most-once throttle already covers each
+            # (key, target) independently, so a half-served batch must
+            # not un-serve the grants that committed.
+            out = []
+            for key, bucket, amount in payload["entries"]:
+                try:
+                    out.append(self._grant_one({
+                        "key": key, "bucket": bucket, "amount": amount,
+                        "to_dc": payload["to_dc"],
+                    }))
+                except (ConnectionError, OSError):
+                    out.append(0)
+            return out
         if kind == "check_up":
             return True
         # follower-replica plane (ISSUE 9)
@@ -619,6 +608,77 @@ class DCReplica:
         if kind == "follower_report":
             return self._serve_follower_report(payload)
         raise ValueError(f"unknown request kind {kind!r}")
+
+    def _grant_one(self, payload) -> int:
+        """Serve ONE rights request at granter entry: consult the
+        ``bcounter.transfer`` fault site (keyed per key, so chaos plans
+        can starve/stretch grant traffic — wide enough to SIGKILL a
+        granter mid-transfer), route clustered DCs through the key
+        owner's coordinator, else commit the grant locally."""
+        import errno as _errno
+
+        from antidote_tpu import faults as _faults
+
+        d = _faults.hit("bcounter.transfer",
+                        key=(payload.get("key"), self.dc_id))
+        if d is not None:
+            if d.action == "delay" and d.arg:
+                time.sleep(float(d.arg))
+            elif d.action == "drop":
+                raise ConnectionError(
+                    "injected fault: bcounter.transfer dropped")
+            elif d.action in ("error", "io_error", "enospc"):
+                raise OSError(
+                    _errno.EIO,
+                    f"injected fault: bcounter.transfer "
+                    f"{payload.get('key')!r}")
+        if self.transfer_handler is not None:
+            # clustered DC: route to the key's owner member, whose
+            # coordinator commits the grant through the sequencer
+            grant = self.transfer_handler(payload)
+        else:
+            grant = self.node.txm.bcounters.process_transfer(
+                self.node.txm, payload["key"], payload["bucket"],
+                payload["amount"], payload["to_dc"],
+            )
+        m = getattr(self.node, "metrics", None)
+        if grant and m is not None:
+            m.escrow_grants.inc(role="granter")
+        return grant
+
+    def _request_transfer_many(self, dc: int, entries) -> None:
+        """Batched rights requests: every shortfall key asking the same
+        granter DC this tick rides ONE query-channel round trip
+        (``bcounter_many``) instead of one RPC per key — a flash-sale
+        tick with hundreds of starved keys was paying hundreds of
+        sequential cross-DC round trips before any grant landed.
+
+        The at-most-once discipline is unchanged and PER ENTRY: the
+        requester's grace throttle was set before the send for each
+        (key, target) pair, so a reply-phase failure of the batch holds
+        every member's throttle — no blind resend of any entry inside
+        the grace window, exactly as if each had been its own RPC."""
+        m = getattr(self.node, "metrics", None)
+        t0 = time.monotonic()
+        try:
+            grants = self.hub.request(
+                dc, "bcounter_many",
+                {"entries": [[k, b, a] for k, b, a in entries],
+                 "to_dc": self.dc_id},
+            )
+        except Exception as e:
+            log.warning(
+                "bcounter batched transfer request to dc%d (%d keys) "
+                "failed typed (%s); grace throttle holds — no blind "
+                "resend", dc, len(entries), e)
+            if m is not None:
+                m.escrow_grants.inc(role="failed")
+            return
+        if m is not None:
+            m.escrow_transfer_seconds.observe(time.monotonic() - t0)
+            for g in (grants or ()):
+                if g:
+                    m.escrow_grants.inc(role="requester")
 
     def _request_transfer(self, dc: int, key, bucket: str,
                           amount: int) -> None:

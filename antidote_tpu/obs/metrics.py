@@ -412,12 +412,37 @@ class NodeMetrics:
         self.shed = r.counter(
             "antidote_shed_total",
             "Requests shed by overload protection, by plane "
-            "(server | server_queue | txn | deadline | read_only)",
+            "(server | server_queue | txn | deadline | read_only | "
+            "tenant — tenant-scoped quota refusals, distinguishable "
+            "from global busy)",
             ("plane",),
         )
         self.in_flight = r.gauge(
             "antidote_server_in_flight",
             "Wire-server requests currently admitted (AdmissionGate)",
+        )
+        # multi-tenant QoS plane (ISSUE 19): per-tenant interference
+        # observability.  The `tenant` label is BOUNDED: every call
+        # site MUST clamp the value through TenantRegistry.label()
+        # (tools/lint.py tenant-label rule) — tenant names come from
+        # operator config, never from the wire.
+        self.tenant_shed = r.counter(
+            "antidote_tenant_shed_total",
+            "Tenant-scoped refusals by lane/stage "
+            "(admission | batch_gate | locked | txn)",
+            ("tenant", "plane"),
+        )
+        self.tenant_in_flight = r.gauge(
+            "antidote_tenant_in_flight",
+            "Requests currently admitted per tenant (AdmissionGate "
+            "tenant accounting)",
+            ("tenant",),
+        )
+        self.tenant_request_seconds = r.histogram(
+            "antidote_tenant_request_seconds",
+            "Wire-server request latency per tenant, submit to reply (s)",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+            label_names=("tenant",),
         )
         self.commit_gate_depth = r.gauge(
             "antidote_commit_gate_depth",

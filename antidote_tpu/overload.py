@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 class BusyError(Exception):
@@ -34,6 +34,22 @@ class BusyError(Exception):
     def __init__(self, msg: str, retry_after_ms: int = 50):
         super().__init__(msg)
         self.retry_after_ms = int(retry_after_ms)
+
+
+class TenantBusyError(BusyError):
+    """Admission refused by a TENANT-scoped bound, not a global one
+    (ISSUE 19): the named tenant is at its own in-flight cap or its own
+    bounded backlog lane is full while the node as a whole still has
+    headroom.  Subclasses :class:`BusyError` so every existing catch
+    site keeps its retry semantics, but the wire mapping checks this
+    type FIRST and encodes ``tenant_busy`` — a client seeing it knows
+    the refusal is its own quota, not node saturation, so backing off
+    (or buying a bigger weight) helps and failing over to a sibling
+    node does not."""
+
+    def __init__(self, msg: str, tenant: str, retry_after_ms: int = 50):
+        super().__init__(msg, retry_after_ms=retry_after_ms)
+        self.tenant = str(tenant)
 
 
 class DeadlineExceeded(Exception):
@@ -174,8 +190,19 @@ def check_deadline(deadline: Optional[float], where: str) -> None:
         )
 
 
+#: refusal streaks with no refusal for this long are forgotten (the
+#: bcounter ``_last_request`` discipline: a stale entry carries no
+#: pressure information, and without a TTL the map grows one entry per
+#: client host ever refused, forever)
+STREAK_TTL_S = 10.0
+#: hard cap on tracked streak entries — a synthetic flood of distinct
+#: client ids must not grow the map unboundedly between TTL sweeps
+_STREAK_MAP_MAX = 4096
+
+
 class AdmissionGate:
-    """Global + per-client in-flight caps for the wire server.
+    """Global + per-client (+ per-tenant, ISSUE 19) in-flight caps for
+    the wire server.
 
     ``enter`` admits or raises :class:`BusyError`; callers MUST pair it
     with ``exit`` (try/finally).  ``client_id`` is an opaque key — the
@@ -183,19 +210,42 @@ class AdmissionGate:
     machine's whole connection fleet (each connection's handler thread
     is serial, so per-socket in-flight never exceeds 1; per-host is the
     accounting that actually stops a greedy client from monopolizing
-    the global budget)."""
+    the global budget).
+
+    ``tenant_enter``/``tenant_exit`` are the tenant-scoped twin, called
+    at the pipeline-submit stage where the decoded request has revealed
+    its tenant: accounting is unconditional (the in-flight gauge and
+    node-status block), the CAP is enforced only for tenants whose
+    registry spec sets ``max_in_flight`` — weights govern queueing
+    order, caps govern concurrency.
+
+    Refusal streaks — the pressure signal behind the retry hint — are
+    tracked PER key (client host or tenant), not gate-global: one hot
+    client hammering a full gate must not inflate every other caller's
+    backoff (a well-behaved first-time client deserves the 25 ms floor,
+    not the hot client's 500 ms ceiling).  The map is bounded and
+    TTL-pruned like bcounter's ``_last_request``."""
 
     def __init__(self, max_in_flight: int = 256, max_per_client: int = 64,
-                 gauge=None):
+                 gauge=None, tenants=None, clock=time.monotonic):
         self.max_in_flight = int(max_in_flight)
         self.max_per_client = int(max_per_client)
+        #: optional TenantRegistry (antidote_tpu.tenancy) holding
+        #: per-tenant in-flight caps; None = untenanted gate
+        self.tenants = tenants
+        self.clock = clock
         self._lock = threading.Lock()
         self._total = 0
         self._per_client: Dict[object, int] = {}
-        #: refusals since the last successful admission — the depth
-        #: signal behind the retry hint (``_total`` itself never
-        #: exceeds the cap, so it carries no pressure information)
-        self._shed_streak = 0
+        #: per-tenant in-flight counts (bounded: keys come from the
+        #: registry's closed name set, never from the wire)
+        self._per_tenant: Dict[str, int] = {}
+        #: refusal streaks per client/tenant key: key -> (streak, last
+        #: refusal time).  A key's streak counts ITS refusals since ITS
+        #: last successful admission.
+        # bounded-by: pruned past STREAK_TTL_S on every refusal sweep,
+        # hard-capped at _STREAK_MAP_MAX entries
+        self._streaks: Dict[object, Tuple[int, float]] = {}
         #: optional obs Gauge mirroring ``self._total``
         self._gauge = gauge
 
@@ -204,16 +254,16 @@ class AdmissionGate:
             if self._total >= self.max_in_flight:
                 raise BusyError(
                     f"server at max_in_flight={self.max_in_flight}",
-                    retry_after_ms=self._retry_hint_locked(),
+                    retry_after_ms=self._retry_hint_locked(client_id),
                 )
             if self._per_client.get(client_id, 0) >= self.max_per_client:
                 raise BusyError(
                     f"client {client_id} at max_in_flight_per_client="
                     f"{self.max_per_client}",
-                    retry_after_ms=self._retry_hint_locked(),
+                    retry_after_ms=self._retry_hint_locked(client_id),
                 )
             self._total += 1
-            self._shed_streak = 0
+            self._streaks.pop(client_id, None)
             self._per_client[client_id] = (
                 self._per_client.get(client_id, 0) + 1)
             if self._gauge is not None:
@@ -230,19 +280,76 @@ class AdmissionGate:
             if self._gauge is not None:
                 self._gauge.set(self._total)
 
+    # ------------------------------------------------------------------
+    # tenant-scoped accounting (ISSUE 19)
+    # ------------------------------------------------------------------
+    def tenant_enter(self, tenant: str) -> None:
+        """Account one in-flight request against ``tenant``; raise
+        :class:`TenantBusyError` if the tenant's configured
+        ``max_in_flight`` cap is reached.  MUST be paired with
+        ``tenant_exit`` (try/finally) once admitted."""
+        cap = None
+        if self.tenants is not None:
+            cap = self.tenants.max_in_flight(tenant)
+        with self._lock:
+            if cap is not None and self._per_tenant.get(tenant, 0) >= cap:
+                raise TenantBusyError(
+                    f"tenant {tenant} at max_in_flight={cap}",
+                    tenant=tenant,
+                    retry_after_ms=self._retry_hint_locked(
+                        ("tenant", tenant)),
+                )
+            self._streaks.pop(("tenant", tenant), None)
+            self._per_tenant[tenant] = self._per_tenant.get(tenant, 0) + 1
+
+    def tenant_exit(self, tenant: str) -> None:
+        with self._lock:
+            n = self._per_tenant.get(tenant, 0) - 1
+            if n <= 0:
+                self._per_tenant.pop(tenant, None)
+            else:
+                self._per_tenant[tenant] = n
+
     def in_flight(self) -> int:
         return self._total
 
-    def _retry_hint_locked(self) -> int:
-        # pressure-scaled hint: refusals since the last successful
-        # admission measure how deep the overload runs — back off
-        # harder the longer the pool has stayed full (bounded
-        # 25..500 ms)
-        self._shed_streak += 1
-        return retry_hint_ms(self._shed_streak)
+    def tenant_in_flight(self, tenant: str) -> int:
+        with self._lock:
+            return self._per_tenant.get(tenant, 0)
+
+    def _retry_hint_locked(self, key) -> int:
+        # pressure-scaled hint PER refusal key: a key's refusals since
+        # its own last admission measure how deep ITS overload runs —
+        # back off harder the longer that caller has been refused
+        # (bounded 25..500 ms), without one hot client inflating every
+        # other caller's backoff
+        now = self.clock()
+        streak = self._streaks.get(key, (0, 0.0))[0] + 1
+        self._streaks[key] = (streak, now)
+        self._prune_streaks_locked(now)
+        return retry_hint_ms(streak)
+
+    def _prune_streaks_locked(self, now: float) -> None:
+        if len(self._streaks) <= _STREAK_MAP_MAX:
+            # cheap common case: sweep expired entries only when the
+            # map has actually accumulated some (the sweep is O(n) and
+            # runs on the refusal path)
+            if len(self._streaks) < 64:
+                return
+            for k, (_, t) in list(self._streaks.items()):
+                if now - t >= STREAK_TTL_S:
+                    del self._streaks[k]
+            return
+        # flood of distinct keys inside one TTL window: drop the oldest
+        # half so the map stays hard-bounded (losing a streak only
+        # resets that caller's hint to the 25 ms floor — safe)
+        victims = sorted(self._streaks.items(), key=lambda kv: kv[1][1])
+        for k, _ in victims[: len(victims) // 2]:
+            del self._streaks[k]
 
 
-__all__ = ["BusyError", "DeadlineExceeded", "ReadOnlyError",
-           "NotOwnerError", "ReplicaLagging", "ReplicaDown", "ColdMiss",
-           "ForwardFailed", "InsufficientRightsError", "AdmissionGate",
+__all__ = ["BusyError", "TenantBusyError", "DeadlineExceeded",
+           "ReadOnlyError", "NotOwnerError", "ReplicaLagging",
+           "ReplicaDown", "ColdMiss", "ForwardFailed",
+           "InsufficientRightsError", "AdmissionGate",
            "deadline_from_ms", "check_deadline", "retry_hint_ms"]

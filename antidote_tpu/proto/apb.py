@@ -510,7 +510,7 @@ def _error(msg: str) -> bytes:
 
 
 def error_text(kind: str, msg: str, retry_after_ms: int = 0,
-               redirect=None, fleet=None) -> str:
+               redirect=None, fleet=None, tenant=None) -> str:
     """Typed error text: proto2 ApbErrorResp has no structured retry or
     redirect field, so the kind + retry-after hint + owner redirect ride
     the errmsg prefix (``"lagging retry_after_ms=NN
@@ -519,10 +519,15 @@ def error_text(kind: str, msg: str, retry_after_ms: int = 0,
     :func:`parse_error_text` — the apb twin of the native dialect's
     structured error fields (ISSUE 11).  ``fleet`` (a list of follower
     endpoints) is the errmsg-encoded ring hint (ISSUE 17): space-free
-    ``fleet=H:P,H:P`` so the existing param grammar carries it."""
+    ``fleet=H:P,H:P`` so the existing param grammar carries it.
+    ``tenant`` (ISSUE 19) names the refusing tenant lane on
+    ``tenant_busy`` replies — registry names are space-free by
+    construction, so the same param grammar carries it."""
     out = kind
     if retry_after_ms:
         out += f" retry_after_ms={int(retry_after_ms)}"
+    if tenant:
+        out += f" tenant={tenant}"
     if redirect:
         out += f" redirect={redirect[0]}:{int(redirect[1])}"
     if fleet:
@@ -550,9 +555,12 @@ def parse_error_text(errmsg) -> Dict[str, Any]:
     kind, params, detail = m.group(1), m.group(2), m.group(3)
     out: Dict[str, Any] = {"kind": kind, "retry_after_ms": 0,
                            "redirect": None, "fleet": None,
-                           "detail": detail}
+                           "tenant": None, "detail": detail}
     for part in params.split():
         k, _, v = part.partition("=")
+        if k == "tenant":
+            out["tenant"] = v
+            continue
         # a malformed value (a foreign server whose errmsg happens to
         # match the prefix shape) falls back to the default, never a
         # crash — the documented never-breaks-a-session contract
@@ -614,9 +622,15 @@ def _error_resp(e, server=None) -> Tuple[str, Dict[str, Any]]:
                                        DeadlineExceeded, ForwardFailed,
                                        InsufficientRightsError,
                                        NotOwnerError, ReadOnlyError,
-                                       ReplicaLagging)
+                                       ReplicaLagging, TenantBusyError)
 
-    if isinstance(e, BusyError):
+    if isinstance(e, TenantBusyError):
+        # tenant-scoped refusal (ISSUE 19): checked BEFORE BusyError
+        # (its base class) so the tenant_busy kind — distinguishable
+        # from global busy — survives the errmsg round trip
+        text = error_text("tenant_busy", str(e), e.retry_after_ms,
+                          tenant=e.tenant)
+    elif isinstance(e, BusyError):
         text = error_text("busy", str(e), e.retry_after_ms)
     elif isinstance(e, InsufficientRightsError):
         # escrow refusal (ISSUE 18): counter_b rights exceeded — the

@@ -44,6 +44,20 @@ class RemoteBusy(RemoteError):
         self.retry_after_ms = int(retry_after_ms)
 
 
+class RemoteTenantBusy(RemoteBusy):
+    """The request was refused by its TENANT's quota (weighted-fair
+    lane full or per-tenant in-flight cap) while the node as a whole
+    had headroom — retrying against a sibling node won't help until
+    this tenant's own backlog drains.  ``tenant`` names the lane;
+    subclasses :class:`RemoteBusy` so generic backoff loops keep
+    working, while fairness-aware callers can tell quota pressure
+    apart from global overload."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50, tenant: str = ""):
+        super().__init__(msg, retry_after_ms=retry_after_ms)
+        self.tenant = str(tenant)
+
+
 class RemoteDeadline(RemoteError):
     """The request outlived its deadline server-side; it was aborted at
     dequeue — never executed."""
@@ -142,7 +156,12 @@ class ClientTxn:
 
 class AntidoteClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8087,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, tenant: Optional[str] = None):
+        #: connection-level tenant tag (ISSUE 19): attached to every
+        #: static read/update body so the server's weighted-fair lanes
+        #: classify this connection even when its buckets are untagged.
+        #: A registered ``tenant/bucket`` prefix still wins server-side.
+        self.tenant = tenant
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
@@ -181,6 +200,10 @@ class AntidoteClient:
             err = resp.get("error")
             if err == "aborted":
                 raise RemoteAbort(resp.get("detail", ""))
+            if err == "tenant_busy":
+                raise RemoteTenantBusy(resp.get("detail", ""),
+                                       int(resp.get("retry_after_ms", 50)),
+                                       tenant=resp.get("tenant") or "")
             if err == "busy":
                 raise RemoteBusy(resp.get("detail", ""),
                                  int(resp.get("retry_after_ms", 50)))
@@ -221,11 +244,16 @@ class AntidoteClient:
     def update_objects(self, updates: Sequence[Tuple],
                        clock: Optional[Sequence[int]] = None,
                        deadline_ms: Optional[float] = None,
-                       proxied: bool = False) -> List[int]:
+                       proxied: bool = False,
+                       tenant: Optional[str] = None) -> List[int]:
         req = {
             "updates": list(updates),
             "clock": None if clock is None else [int(x) for x in clock],
         }
+        if tenant is None:
+            tenant = self.tenant
+        if tenant:
+            req["tenant"] = tenant
         if deadline_ms is not None:
             # relative budget; the server aborts the request at dequeue
             # once it has outlived this (RemoteDeadline reply)
@@ -241,11 +269,16 @@ class AntidoteClient:
     def read_objects(self, objects: Sequence[Tuple[Any, str, str]],
                      clock: Optional[Sequence[int]] = None,
                      deadline_ms: Optional[float] = None,
-                     proxied: bool = False):
+                     proxied: bool = False,
+                     tenant: Optional[str] = None):
         req = {
             "objects": list(objects),
             "clock": None if clock is None else [int(x) for x in clock],
         }
+        if tenant is None:
+            tenant = self.tenant
+        if tenant:
+            req["tenant"] = tenant
         if deadline_ms is not None:
             req["deadline_ms"] = float(deadline_ms)
         if proxied:
@@ -444,6 +477,9 @@ class ApbClient:
                     "followers": err.get("fleet") or [],
                     "vnodes": None,
                 }
+            if kind == "tenant_busy":
+                raise RemoteTenantBusy(detail, err["retry_after_ms"],
+                                       tenant=err.get("tenant") or "")
             if kind == "busy":
                 raise RemoteBusy(detail, err["retry_after_ms"])
             if kind == "deadline":

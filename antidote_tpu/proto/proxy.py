@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 from antidote_tpu import faults as _faults
 from antidote_tpu.overload import (
     BusyError,
+    TenantBusyError,
     ColdMiss,
     DeadlineExceeded,
     ForwardFailed,
@@ -64,6 +65,7 @@ from antidote_tpu.proto.client import (
     RemoteLagging,
     RemoteNotOwner,
     RemoteReadOnly,
+    RemoteTenantBusy,
 )
 
 Addr = Tuple[str, int]
@@ -87,6 +89,11 @@ def _rethrow(e: BaseException) -> None:
     on these types)."""
     from antidote_tpu.txn.manager import AbortError
 
+    if isinstance(e, RemoteTenantBusy):
+        # preserve the tenant attribution across the hop: the edge
+        # reply must still say WHICH lane refused, not "node busy"
+        raise TenantBusyError(str(e), tenant=e.tenant,
+                              retry_after_ms=e.retry_after_ms) from e
     if isinstance(e, RemoteBusy):
         raise BusyError(str(e), e.retry_after_ms) from e
     if isinstance(e, RemoteDeadline):
@@ -349,7 +356,8 @@ class ProxyPlane:
 
     # -- read proxying --------------------------------------------------
     def proxy_read(self, objects, clock, deadline: Optional[float],
-                   first: Optional[Addr] = None):
+                   first: Optional[Addr] = None,
+                   tenant: Optional[str] = None):
         """Relay a read to the arc owner, failing over server-side
         through the arc's live shadows and the owner.  Returns
         ``(values, commit_clock)`` exactly as the target answered;
@@ -392,7 +400,7 @@ class ProxyPlane:
                 vals, vc = c.read_objects(
                     objects, clock=clock,
                     deadline_ms=self._remaining_ms(deadline),
-                    proxied=True)
+                    proxied=True, tenant=tenant)
             except (RemoteLagging, RemoteNotOwner, RemoteBusy) as e:
                 # the hop is up but refused (behind the token / ring
                 # disagreement / shedding): try the next shadow — its
@@ -423,7 +431,8 @@ class ProxyPlane:
         raise ProxyExhausted(last)
 
     # -- write forwarding -----------------------------------------------
-    def forward_update(self, updates, clock, deadline: Optional[float]):
+    def forward_update(self, updates, clock, deadline: Optional[float],
+                       tenant: Optional[str] = None):
         """Forward a static write to the owner write plane, at most
         once: dial/send-phase failures redial within the bounded
         budget; a reply-phase failure surfaces the typed
@@ -452,7 +461,7 @@ class ProxyPlane:
                 vc = c.update_objects(
                     updates, clock=clock,
                     deadline_ms=self._remaining_ms(deadline),
-                    proxied=True)
+                    proxied=True, tenant=tenant)
             except (ConnectionError, OSError) as e:
                 self._scrap(c)
                 if getattr(e, "request_sent", True):

@@ -38,9 +38,11 @@ from antidote_tpu.overload import (
     NotOwnerError,
     ReadOnlyError,
     ReplicaLagging,
+    TenantBusyError,
     check_deadline,
     deadline_from_ms,
 )
+from antidote_tpu.tenancy import TenantLanes, TenantRegistry
 from antidote_tpu.proto import apb
 from antidote_tpu.proto.proxy import ProxyExhausted, ProxyPlane
 from antidote_tpu.proto.codec import (
@@ -67,14 +69,17 @@ class _StaticWork:
 
     __slots__ = ("kind", "objects", "updates", "clock", "event", "result",
                  "error", "deadline", "t_submit", "wants_bytes",
-                 "reply_bytes", "txid")
+                 "reply_bytes", "txid", "tenant")
 
     def __init__(self, kind, objects=None, updates=None, clock=None,
-                 deadline=None, wants_bytes=False, txid=None):
+                 deadline=None, wants_bytes=False, txid=None, tenant=None):
         self.kind = kind
         self.objects = objects
         self.updates = updates
         self.clock = clock
+        #: tenant lane this work rides (ISSUE 19): derived from the
+        #: bucket namespace / request tag at decode; None = default
+        self.tenant = tenant
         #: interactive commit works (kind == "commit") carry the txid;
         #: the locked worker resolves it to the registered Transaction
         #: at the merge point
@@ -144,8 +149,13 @@ class ProtocolServer:
                  group_commit_window_us: float = 0.0,
                  follower=None, native_frontend: bool = False,
                  native_mirror_cap: int = 1 << 18,
-                 server_proxy: bool = True):
+                 server_proxy: bool = True, tenants=None):
         self.node = node
+        #: multi-tenant QoS (ISSUE 19): weights + caps for every tenant
+        #: this node serves.  An untenanted node gets a registry holding
+        #: only the default lane — every tenant code path then
+        #: degenerates to the old single-queue behavior.
+        self.tenants: TenantRegistry = tenants or TenantRegistry()
         #: DCReplica for the descriptor/connect requests (optional)
         self.interdc = interdc
         #: FollowerReplica when this server fronts a read replica
@@ -198,7 +208,7 @@ class ProtocolServer:
         #: fleet is what actually prevents monopolization
         self.admission = AdmissionGate(
             max_in_flight, max_in_flight_per_client,
-            gauge=self.metrics.in_flight,
+            gauge=self.metrics.in_flight, tenants=self.tenants,
         )
         #: default per-request deadline (ms) when the client sends none;
         #: None = requests without a deadline_ms field never expire
@@ -214,8 +224,12 @@ class ProtocolServer:
         self._closing = False
         #: BOUNDED: a full gate answers busy instead of buffering without
         #: limit (admission usually sheds first; this cap is the backstop
-        #: against a stalled dispatcher)
-        self._static_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        #: against a stalled dispatcher).  Per-tenant bounded LANES with
+        #: deficit-round-robin dequeue (ISSUE 19): a backlogged tenant
+        #: fills its OWN lane and sheds typed tenant_busy there, instead
+        #: of occupying the shared budget everyone else's requests ride.
+        self._static_q = TenantLanes(self.tenants, queue_max,
+                                     name="static batch gate")
         self._batch_max = 1024
         #: per-handler-thread scratch (stage_decode timing)
         self._tls = threading.local()
@@ -223,6 +237,10 @@ class ProtocolServer:
         #: serving-epoch publication cadence for the dedicated ticker
         self.epoch_tick_ms = epoch_tick_ms
         txm = getattr(node, "txm", None)
+        if txm is not None:
+            # the group-commit merge point caps any single tenant's
+            # share of one merged batch (weight-proportional rounds)
+            txm.tenants = self.tenants
         #: lock-split epoch reads need the single-node txn manager (the
         #: cluster facade routes through 2PC) and the batch dispatcher;
         #: epoch_tick_ms <= 0 disables the whole epoch plane (operator
@@ -255,8 +273,11 @@ class ProtocolServer:
         #: reads the epoch cannot serve, processed by a dedicated worker
         #: so a commit group (or an XLA compile hiding inside one) never
         #: parks the dispatcher's read-launch stage.  BOUNDED: past the
-        #: cap the work sheds with a typed busy error, same as the gate.
-        self._locked_q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        #: cap the work sheds with a typed busy error, same as the gate
+        #: — per-tenant lanes + DRR here too (the merge point is where a
+        #: write storm actually queues)
+        self._locked_q = TenantLanes(self.tenants, queue_max,
+                                     name="locked plane")
         #: optional gather window at the merge point: after the locked
         #: worker's first dequeue it keeps draining up to this long, so
         #: moderate-load commit groups widen before taking the commit
@@ -513,6 +534,16 @@ class ProtocolServer:
                 "error": "insufficient_rights", "detail": str(e),
                 "retry_after_ms": int(e.retry_after_ms),
             }
+        except TenantBusyError as e:
+            # tenant-scoped quota/lane refusal (ISSUE 19): typed
+            # distinctly from global busy — the client learns its OWN
+            # quota (not the node) is the bottleneck, so failover to a
+            # sibling node won't help but backing off will
+            resp_code, resp = MessageCode.ERROR_RESP, {
+                "error": "tenant_busy", "detail": str(e),
+                "retry_after_ms": int(e.retry_after_ms),
+                "tenant": e.tenant,
+            }
         except BusyError as e:
             # downstream cap (commit backlog / batch gate): same typed
             # shape as the admission shed
@@ -729,10 +760,12 @@ class ProtocolServer:
     # ------------------------------------------------------------------
     # static batch gate
     # ------------------------------------------------------------------
-    def static_read(self, objects, clock, deadline=None, wants_bytes=False):
+    def static_read(self, objects, clock, deadline=None, wants_bytes=False,
+                    tenant=None):
         """Batched static read: (values, snapshot_vc) — or a
         :class:`RawReply` when ``wants_bytes`` and the writeback stage
         serialized the native reply frame itself."""
+        tenant = self.tenants.resolve(tenant, (o[2] for o in objects))
         if not self.batch_static:
             with self._lock:
                 check_deadline(deadline, "dispatch")
@@ -742,7 +775,8 @@ class ProtocolServer:
         if fast is not None:
             return fast
         w = _StaticWork("read", objects=objects, clock=clock_vc,
-                        deadline=deadline, wants_bytes=wants_bytes)
+                        deadline=deadline, wants_bytes=wants_bytes,
+                        tenant=tenant)
         out = self._submit(w)
         if w.reply_bytes is not None:
             return RawReply(w.reply_bytes)
@@ -779,49 +813,80 @@ class ProtocolServer:
             }))
         return vals, vc_list
 
-    def static_update(self, updates, clock, deadline=None):
+    def static_update(self, updates, clock, deadline=None, tenant=None):
         """Batched static update: commit VC (raises AbortError on cert).
         Parks DIRECTLY at the locked worker's merge point — the
         dispatcher stage only ever forwarded updates, and the extra
         queue hop + thread wakeup per write was measurable on the
         2-core write-plane floor (ISSUE 6)."""
+        tenant = self.tenants.resolve(tenant, (u[2] for u in updates))
         if not self.batch_static:
             with self._lock:
                 check_deadline(deadline, "dispatch")
                 return self.node.update_objects(updates, clock=_vc(clock))
         return self._submit(_StaticWork("update", updates=updates,
                                         clock=_vc(clock),
-                                        deadline=deadline),
+                                        deadline=deadline, tenant=tenant),
                             self._locked_q)
 
-    def _submit(self, work: _StaticWork, q: Optional["queue.Queue"] = None):
+    def _submit(self, work: _StaticWork, q: Optional[TenantLanes] = None):
         """Park a work on a pipeline queue (default: the batch gate;
         interactive commits go straight to the locked-plane merge point
-        — one hop fewer) and wait for its stage to reply."""
+        — one hop fewer) and wait for its stage to reply.  Tenant
+        discipline (ISSUE 19): the work enters its tenant's in-flight
+        account (typed ``tenant_busy`` past a configured cap) and its
+        tenant's bounded LANE — never the shared budget."""
         if self._closing:
             raise ConnectionError("server shutting down")
         if q is None:
             q = self._static_q
+        tenant = self.tenants.label(work.tenant)
+        m = self.metrics
+        try:
+            self.admission.tenant_enter(tenant)
+        except TenantBusyError:
+            m.shed.inc(plane="tenant")
+            # tenant-label-ok: `tenant` is clamped by TenantRegistry.label
+            m.tenant_shed.inc(tenant=tenant, plane="admission")
+            raise
         now = time.monotonic()
         work.t_submit = now
         t0 = getattr(self._tls, "t0", None)
         if t0 is not None:
-            self.metrics.stage_decode_seconds.observe(now - t0)
+            m.stage_decode_seconds.observe(now - t0)
             self._tls.t0 = None
         try:
-            # bounded gate: shed with a typed busy error instead of
-            # parking behind an unbounded backlog
-            q.put_nowait(work)
-        except queue.Full:
-            self.metrics.shed.inc(plane="server_queue")
-            raise BusyError(
-                f"static batch gate full ({q.maxsize} requests parked)",
-                retry_after_ms=100,
-            ) from None
-        if q is self._static_q:
-            self.metrics.commit_gate_depth.set(q.qsize())
-        if not work.event.wait(timeout=300):
-            raise TimeoutError("static batch dispatcher stalled")
+            try:
+                # bounded gate: shed with a typed busy error instead of
+                # parking behind an unbounded backlog
+                q.put_nowait(work, tenant)
+            except TenantBusyError:
+                m.shed.inc(plane="tenant")
+                # tenant-label-ok: clamped by TenantRegistry.label above
+                m.tenant_shed.inc(
+                    tenant=tenant,
+                    plane=("batch_gate" if q is self._static_q
+                           else "locked"))
+                raise
+            except (BusyError, queue.Full):
+                m.shed.inc(plane="server_queue")
+                raise BusyError(
+                    f"static batch gate full ({q.maxsize} requests "
+                    f"parked)",
+                    retry_after_ms=100,
+                ) from None
+            if q is self._static_q:
+                m.commit_gate_depth.set(q.qsize())
+            if not work.event.wait(timeout=300):
+                raise TimeoutError("static batch dispatcher stalled")
+        finally:
+            self.admission.tenant_exit(tenant)
+            # tenant-label-ok: clamped by TenantRegistry.label above
+            m.tenant_in_flight.set(
+                self.admission.tenant_in_flight(tenant), tenant=tenant)
+        # tenant-label-ok: clamped by TenantRegistry.label above
+        m.tenant_request_seconds.observe(time.monotonic() - now,
+                                         tenant=tenant)
         if work.error is not None:
             raise work.error
         return work.result
@@ -919,8 +984,16 @@ class ProtocolServer:
                 # gather: a real, if wasted, launch)
                 for w in rest + reads:
                     try:
-                        self._locked_q.put_nowait(w)
-                    except queue.Full:
+                        self._locked_q.put_nowait(
+                            w, self.tenants.label(w.tenant))
+                    except TenantBusyError as e:
+                        m.shed.inc(plane="tenant")
+                        # tenant-label-ok: clamped via TenantRegistry.label
+                        m.tenant_shed.inc(tenant=e.tenant, plane="locked")
+                        w.error = e
+                        w.event.set()
+                        continue
+                    except (BusyError, queue.Full):
                         m.shed.inc(plane="server_queue")
                         w.error = BusyError(
                             f"static batch gate full (locked plane: "
@@ -1397,7 +1470,7 @@ class ProtocolServer:
                 raise NotOwnerError(fol.owner_client_addr)
             vc = plane.forward_update(
                 _decode_updates(body["updates"]), body.get("clock"),
-                deadline,
+                deadline, tenant=body.get("tenant"),
             )
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
@@ -1455,7 +1528,7 @@ class ProtocolServer:
         return MessageCode.OPERATION_RESP, resp
 
     def _follower_read(self, objs, clock, deadline, dialect: str = "native",
-                       proxied: bool = False):
+                       proxied: bool = False, tenant=None):
         """Session read at a follower entrypoint.  Returns
         ``(out, via_proxy)``: in-arc keys serve locally (token-gated,
         with a server-side proxy failover when the gate refuses);
@@ -1469,7 +1542,8 @@ class ProtocolServer:
         def _local():
             fol.gate_read(objs, _vc(clock), deadline, dialect=dialect)
             return self.static_read(objs, clock, deadline=deadline,
-                                    wants_bytes=wants_bytes), False
+                                    wants_bytes=wants_bytes,
+                                    tenant=tenant), False
 
         if plane is None or proxied:
             return _local()
@@ -1482,12 +1556,13 @@ class ProtocolServer:
                 return _local()
             except ReplicaLagging as gate_err:
                 try:
-                    return plane.proxy_read(objs, clock, deadline), True
+                    return plane.proxy_read(objs, clock, deadline,
+                                            tenant=tenant), True
                 except ProxyExhausted:
                     raise gate_err from None
         try:
             return plane.proxy_read(objs, clock, deadline,
-                                    first=target), True
+                                    first=target, tenant=tenant), True
         except ProxyExhausted:
             # every remote hop failed: terminal local attempt — the
             # gate's typed refusal is the honest last resort
@@ -1522,6 +1597,7 @@ class ProtocolServer:
                 out, via_proxy = self._follower_read(
                     objs, body.get("clock"), deadline,
                     proxied=bool(body.get("proxied")),
+                    tenant=body.get("tenant"),
                 )
                 if via_proxy:
                     vals, vc = out
@@ -1537,6 +1613,7 @@ class ProtocolServer:
                 out = self.static_read(
                     objs, body.get("clock"),
                     deadline=deadline, wants_bytes=True,
+                    tenant=body.get("tenant"),
                 )
             if isinstance(out, RawReply):
                 # batched reply serialization: the writeback stage framed
@@ -1550,7 +1627,7 @@ class ProtocolServer:
         if code == MessageCode.STATIC_UPDATE_OBJECTS:
             vc = self.static_update(
                 _decode_updates(body["updates"]), body.get("clock"),
-                deadline=deadline,
+                deadline=deadline, tenant=body.get("tenant"),
             )
             return MessageCode.COMMIT_RESP, {
                 "commit_clock": [int(x) for x in vc]
@@ -1563,7 +1640,15 @@ class ProtocolServer:
             # worker and fuses with whatever static updates and OTHER
             # connections' commits drained in the same batch
             txid = body["txid"]
-            w = _StaticWork("commit", deadline=deadline, txid=txid)
+            # an interactive commit's tenant comes from its buffered
+            # writeset's buckets (the txn was started tag-free)
+            with self._lock:
+                txn = self._txns.get(txid)
+            tenant = self.tenants.resolve(
+                body.get("tenant"),
+                (e.bucket for e, _ in getattr(txn, "writeset", ()) or ()))
+            w = _StaticWork("commit", deadline=deadline, txid=txid,
+                            tenant=tenant)
             try:
                 vc = self._submit(w, self._locked_q)
             except BusyError:
@@ -1684,6 +1769,7 @@ class ProtocolServer:
                 "batch_gate_max": self._static_q.maxsize,
             })
             status["pipeline"] = self._pipeline_status()
+            status["tenants"] = self._tenant_status()
             if self.interdc is not None and hasattr(self.interdc,
                                                     "replica_status"):
                 # follower liveness (owner: every follower with its
@@ -1727,6 +1813,26 @@ class ProtocolServer:
                 "create_dc_failed: multi-member DCs assemble via "
                 "cluster.boot + ctl_wire, not the client protocol"
             )
+
+    # ------------------------------------------------------------------
+    def _tenant_status(self) -> dict:
+        """Per-tenant QoS block for node status (ISSUE 19): configured
+        weight/caps plus live in-flight, lane depths and typed-shed
+        odometers — the block that makes noisy-neighbor interference
+        observable before anyone's p99 says so."""
+        gate = self._static_q.status()
+        locked = self._locked_q.status()
+        out = {"multi": self.tenants.multi, "tenants": {}}
+        for name in self.tenants.names:
+            spec = self.tenants.spec(name)
+            out["tenants"][name] = {
+                "weight": spec.weight,
+                "max_in_flight": spec.max_in_flight,
+                "in_flight": self.admission.tenant_in_flight(name),
+                "batch_gate": gate.get(name, {}),
+                "locked": locked.get(name, {}),
+            }
+        return out
 
     # ------------------------------------------------------------------
     def _pipeline_status(self) -> dict:

@@ -22,7 +22,7 @@ BEFORE the send — prevents a blind resend inside the window.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -79,6 +79,14 @@ class BCounterManager:
         self._refusals: Dict[QueueKey, Tuple[int, float]] = {}
         #: wired by the inter-DC layer: (target_dc, key, bucket, amount) -> None
         self.request_transfer: Optional[Callable[[int, Any, str, int], None]] = None
+        #: batched twin (ISSUE 19 residual): (target_dc, [(key, bucket,
+        #: amount), ...]) -> None.  When wired, one tick's asks against
+        #: the same granter DC ride ONE query-channel round trip instead
+        #: of one per key — a flash-sale tick with hundreds of starved
+        #: keys was paying hundreds of sequential RPCs.  Optional: the
+        #: per-key path stays the fallback (and the semantics oracle).
+        self.request_transfer_many: Optional[
+            Callable[[int, List[Tuple[Any, str, int]]], None]] = None
         # escrow-economy odometers (node status / console ready line;
         # the Prometheus twins live in obs.metrics and are bumped by the
         # planes that own them)
@@ -137,9 +145,14 @@ class BCounterManager:
         for qk, (streak, t) in list(self._refusals.items()):
             if now - t >= STREAK_TTL and qk not in self.pending:
                 del self._refusals[qk]
-        if self.request_transfer is None or not self.pending:
+        if ((self.request_transfer is None
+             and self.request_transfer_many is None) or not self.pending):
             return 0
         sent = 0
+        #: asks gathered across ALL shortfall keys this tick, so the
+        #: same-granter ones can share one round trip: (dc, key, bucket,
+        #: amount) in decision order
+        asks: List[Tuple[int, Any, str, int]] = []
         for (key, bucket), needed in list(self.pending.items()):
             state = read_state(key, bucket)
             if state is None:
@@ -180,12 +193,23 @@ class BCounterManager:
                 # throttle BEFORE the send: the query channel is
                 # at-most-once and grants are non-idempotent, so a
                 # reply-phase failure must NOT earn an immediate
-                # blind resend inside the grace window
+                # blind resend inside the grace window (the batched
+                # path inherits this per-(key, target) discipline —
+                # batching changes the FRAMING, not the retry contract)
                 self._last_request[tk] = now
-                self.request_transfer(dc, key, bucket, ask)
+                asks.append((dc, key, bucket, ask))
                 remaining -= ask
                 sent += 1
                 self.requests_sent_total += 1
+        if self.request_transfer_many is not None:
+            by_dc: Dict[int, List[Tuple[Any, str, int]]] = {}
+            for dc, key, bucket, ask in asks:
+                by_dc.setdefault(dc, []).append((key, bucket, ask))
+            for dc, entries in by_dc.items():
+                self.request_transfer_many(dc, entries)
+        else:
+            for dc, key, bucket, ask in asks:
+                self.request_transfer(dc, key, bucket, ask)
         return sent
 
     def satisfied(self, key, bucket: str) -> None:

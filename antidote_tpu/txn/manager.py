@@ -148,6 +148,12 @@ class TransactionManager:
         self.max_commit_backlog = 64
         self._backlog_lock = _threading.Lock()
         self._commit_backlog = 0
+        #: multi-tenant QoS (ISSUE 19): when the serving layer installs
+        #: a TenantRegistry here, a merged group-commit batch is split
+        #: into weight-proportional ROUNDS so no tenant's writes occupy
+        #: more than its share of the merge (work-conserving: a lone
+        #: tenant still gets the whole batch).  None = untenanted.
+        self.tenants = None
         #: non-None while the node is in degraded READ-ONLY mode: the
         #: WAL refused an append (ENOSPC / EIO).  Writes are rejected
         #: with ReadOnlyError, reads keep serving, and the mode exits
@@ -644,8 +650,23 @@ class TransactionManager:
         caller while queued is aborted at dequeue, not executed.  A
         write-bearing group is refused with :class:`ReadOnlyError` while
         the node is in degraded read-only mode (the check also runs the
-        auto-recovery probe)."""
+        auto-recovery probe).
+
+        Multi-tenant QoS (ISSUE 19): with a :class:`TenantRegistry`
+        installed (``self.tenants``), the group is split into weight-
+        proportional ROUNDS — each a full merged batch of its own — so
+        one tenant's write storm cannot occupy an entire merged batch
+        while a sibling's single commit waits behind it.  Work-
+        conserving: a single-tenant group stays one round (the exact
+        pre-tenancy path).  Backlog admission, the deadline check and
+        the writable check cover the whole group up front; a FIRST-
+        round failure re-raises (nothing committed), a LATER-round
+        failure must NOT raise — earlier rounds' commit VCs are already
+        final, so the error surfaces as the failed txns' per-txn
+        results instead (their txns aborted), never as a group-level
+        exception that would make the caller retry acked work."""
         has_writes = any(t.writeset for t in txns)
+        rounds = self._tenant_rounds(txns)
         # backlog admission OUTSIDE the abort-cleanup scope: a backlog
         # shed happens before the group's state is touched, so the txns
         # stay OPEN and the caller may retry the same commit — the busy
@@ -661,99 +682,160 @@ class TransactionManager:
             self._commit_backlog += 1
         try:
             try:
-                with self.commit_lock:
+                results: dict = {}
+                for t, r in zip(rounds[0],
+                                self._commit_round(rounds[0], deadline,
+                                                   has_writes, first=True)):
+                    results[id(t)] = r
+                for ri in range(1, len(rounds)):
                     try:
-                        check_deadline(deadline, "commit dequeue")
-                    except DeadlineExceeded:
-                        if self.metrics is not None:
-                            self.metrics.shed.inc(plane="deadline")
-                        raise
-                    if has_writes:
-                        self.check_writable()
-                    t0 = time.monotonic()
-                    try:
-                        out = self._commit_group_locked(txns)
-                        if has_writes and self.serving_epochs:
-                            # publish BEFORE the ack leaves: a clockless
-                            # read admitted after this commit's reply must
-                            # find an epoch that covers it (read-your-
-                            # writes stays intact under the lock split).
-                            # A deferred/failed publish raises the lag
-                            # floor instead — epoch reads below it fall
-                            # back to the (always-fresh) locked path.
-                            # WRITE-STORM DEFERRAL (ISSUE 6): with the
-                            # epoch plane idle (no epoch-path read since
-                            # the last publish), the per-batch publish
-                            # scatter was >60% of batch cost serving
-                            # nobody — those batches defer (lag floor
-                            # up; any arriving read stays correct via
-                            # the locked path) up to the rate window.
-                            # The moment epoch reads flow, every batch
-                            # publishes before its ack again (deferring
-                            # mixed loads reroutes the read majority to
-                            # the locked plane and blows up its tail).
-                            now2 = time.monotonic()
-                            reads_now = -1.0
-                            if self.metrics is not None:
-                                sr = self.metrics.serving_reads
-                                reads_now = (sr.value(path="cache")
-                                             + sr.value(path="gather"))
-                            idle = (reads_now ==
-                                    self._reads_at_last_publish)
-                            if (idle and now2 - self._last_inline_publish
-                                    < self.EPOCH_INLINE_PUBLISH_S):
-                                self.epoch_lag_counter = self.commit_counter
-                                self._native_lag_raised()
-                            else:
-                                self._last_inline_publish = now2
-                                self._reads_at_last_publish = reads_now
-                                try:
-                                    st = self._publish_serving_epoch_locked()
-                                except Exception:
-                                    st = "error"
-                                    log.exception(
-                                        "serving-epoch publish failed")
-                                if st not in ("published", "noop"):
-                                    self.epoch_lag_counter = (
-                                        self.commit_counter)
-                                    self._native_lag_raised()
-                    except OSError as e:
-                        if has_writes and e.errno in (errno.ENOSPC,
-                                                      errno.EIO,
-                                                      errno.EROFS,
-                                                      errno.EDQUOT):
-                            # the WAL refused the append BEFORE any device
-                            # table mutated (durability-first ordering in
-                            # KVStore.apply_effects): fail the group and
-                            # flip into read-only degraded mode
-                            self._enter_read_only(e)
-                            raise ReadOnlyError(
-                                self.read_only_reason) from e
-                        raise
-                    finally:
-                        if self.metrics is not None and has_writes:
-                            self.metrics.commit_seconds.observe(
-                                time.monotonic() - t0)
-                            self.metrics.commit_merge_width.observe(
-                                sum(1 for t in txns if t.writeset))
+                        outs = self._commit_round(rounds[ri], deadline,
+                                                  has_writes, first=False)
+                    except BaseException as e:
+                        # rounds before this one COMMITTED and their VCs
+                        # already sit in `results`: re-raising would make
+                        # the server error every member — including works
+                        # whose commits landed — and a client's blind
+                        # resend would double-apply them.  Fail the rest
+                        # per-txn instead: abort their still-active txns
+                        # and surface the error as each one's result
+                        # (the same closed-txn contract the per-txn
+                        # AbortError entries carry).
+                        err = e if isinstance(e, Exception) \
+                            else RuntimeError(f"commit round failed: {e!r}")
+                        for rnd in rounds[ri:]:
+                            for t in rnd:
+                                if t.active:
+                                    self._mark_aborted(t)
+                                results[id(t)] = err
+                        break
+                    for t, r in zip(rounds[ri], outs):
+                        results[id(t)] = r
                 if (self.metrics is not None and has_writes
                         and self.store.log is not None):
                     for i, d in enumerate(self.store.log.segment_depths()):
                         self.metrics.wal_segment_depth.set(d,
                                                            segment=str(i))
-                return out
+                return [results[id(t)] for t in txns]
             finally:
                 with self._backlog_lock:
                     self._commit_backlog -= 1
         except BaseException:
             # a shed/failed group must not leak open transactions: they
             # pin the certification-GC floor forever (the same reason the
-            # server aborts orphans of dead connections).  Whatever
-            # _commit_group_locked already closed stays closed.
+            # server aborts orphans of dead connections).  Only round 1
+            # can land here (deadline/writable/WAL refusal before any
+            # commit) — later-round failures were converted to per-txn
+            # results above.  Whatever _commit_group_locked already
+            # closed stays closed.
             for t in txns:
                 if t.active:
                     self._mark_aborted(t)
             raise
+
+    def _tenant_rounds(self, txns: Sequence[Transaction]) -> List[List]:
+        """Weight-proportional round split of one merged commit group
+        (ISSUE 19).  Untenanted managers, single-member groups and
+        groups whose members all belong to one tenant keep the
+        one-round fast path — byte-for-byte the pre-tenancy batch,
+        zero extra lock cycles."""
+        reg = self.tenants
+        if reg is None or not getattr(reg, "multi", False) or len(txns) <= 1:
+            return [list(txns)]
+        from antidote_tpu.tenancy import batch_rounds
+
+        def tenant_of(t):
+            return reg.resolve(None, (e.bucket for e, _ in t.writeset))
+
+        return batch_rounds(list(txns), tenant_of, reg)
+
+    def _commit_round(self, txns: Sequence[Transaction],
+                      deadline: Optional[float], has_writes: bool,
+                      first: bool) -> List[Any]:
+        """One merged batch under the commit lock — the pre-tenancy
+        ``commit_transactions_group`` critical section, verbatim.  The
+        deadline/writable admission checks run on the FIRST round only:
+        they gate the group (nothing committed yet, failure is cleanly
+        retryable); later rounds must run to completion so the split
+        never strands a group half-checked."""
+        round_writes = any(t.writeset for t in txns)
+        with self.commit_lock:
+            if first:
+                try:
+                    check_deadline(deadline, "commit dequeue")
+                except DeadlineExceeded:
+                    if self.metrics is not None:
+                        self.metrics.shed.inc(plane="deadline")
+                    raise
+                if has_writes:
+                    self.check_writable()
+            t0 = time.monotonic()
+            try:
+                out = self._commit_group_locked(txns)
+                if round_writes and self.serving_epochs:
+                    # publish BEFORE the ack leaves: a clockless
+                    # read admitted after this commit's reply must
+                    # find an epoch that covers it (read-your-
+                    # writes stays intact under the lock split).
+                    # A deferred/failed publish raises the lag
+                    # floor instead — epoch reads below it fall
+                    # back to the (always-fresh) locked path.
+                    # WRITE-STORM DEFERRAL (ISSUE 6): with the
+                    # epoch plane idle (no epoch-path read since
+                    # the last publish), the per-batch publish
+                    # scatter was >60% of batch cost serving
+                    # nobody — those batches defer (lag floor
+                    # up; any arriving read stays correct via
+                    # the locked path) up to the rate window.
+                    # The moment epoch reads flow, every batch
+                    # publishes before its ack again (deferring
+                    # mixed loads reroutes the read majority to
+                    # the locked plane and blows up its tail).
+                    now2 = time.monotonic()
+                    reads_now = -1.0
+                    if self.metrics is not None:
+                        sr = self.metrics.serving_reads
+                        reads_now = (sr.value(path="cache")
+                                     + sr.value(path="gather"))
+                    idle = (reads_now ==
+                            self._reads_at_last_publish)
+                    if (idle and now2 - self._last_inline_publish
+                            < self.EPOCH_INLINE_PUBLISH_S):
+                        self.epoch_lag_counter = self.commit_counter
+                        self._native_lag_raised()
+                    else:
+                        self._last_inline_publish = now2
+                        self._reads_at_last_publish = reads_now
+                        try:
+                            st = self._publish_serving_epoch_locked()
+                        except Exception:
+                            st = "error"
+                            log.exception(
+                                "serving-epoch publish failed")
+                        if st not in ("published", "noop"):
+                            self.epoch_lag_counter = (
+                                self.commit_counter)
+                            self._native_lag_raised()
+            except OSError as e:
+                if round_writes and e.errno in (errno.ENOSPC,
+                                                errno.EIO,
+                                                errno.EROFS,
+                                                errno.EDQUOT):
+                    # the WAL refused the append BEFORE any device
+                    # table mutated (durability-first ordering in
+                    # KVStore.apply_effects): fail the round and
+                    # flip into read-only degraded mode
+                    self._enter_read_only(e)
+                    raise ReadOnlyError(
+                        self.read_only_reason) from e
+                raise
+            finally:
+                if self.metrics is not None and round_writes:
+                    self.metrics.commit_seconds.observe(
+                        time.monotonic() - t0)
+                    self.metrics.commit_merge_width.observe(
+                        sum(1 for t in txns if t.writeset))
+        return out
 
     def _wal_refusal(self, e: Exception) -> Exception:
         """Map a sub-group's WAL refusal to the client-facing error: a

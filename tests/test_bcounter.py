@@ -202,7 +202,9 @@ def test_refusal_streak_scales_hint_and_rebalance(dcs):
             bc.HINT_CAP_MS, base * (1 + streak)
         )
     # streak 3 >= REBALANCE_STREAK: the request over-asks by the factor
+    # (exercise the per-key fallback; the batched twin has its own test)
     captured = []
+    mgr.request_transfer_many = None
     mgr.request_transfer = lambda dc, key, bucket, n: captured.append(n)
     reps[1].bcounter_tick()
     assert captured == [5 * min(bc.REBALANCE_MAX_FACTOR, 3)]
@@ -299,3 +301,78 @@ def test_rights_conservation_under_seeded_interleavings(dcs):
         assert sum(ty.local_rights(st, dc) for dc in range(d)) == total - sold
         assert int(np.trace(np.asarray(st["rights"]))) == total
         assert all(ty.local_rights(st, dc) >= 0 for dc in range(d))
+
+
+def test_transfer_requests_batch_into_one_round_trip(dcs):
+    """Satellite (b) of ISSUE 19: many shortfall keys aimed at the same
+    granter ride ONE ``bcounter_many`` query-channel round trip.  The
+    throttle is stamped at accumulation time, so batching changes the
+    FRAMING, not the retry contract — a second tick in the same grace
+    period sends nothing."""
+    hub, nodes, reps = dcs
+    t = [0.0]
+    mgr = nodes[1].txm.bcounters
+    mgr.clock = lambda: t[0]
+    for k in ("c1", "c2", "c3"):
+        nodes[0].update_objects(
+            [(k, "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    for k in ("c1", "c2", "c3"):
+        with pytest.raises(InsufficientRightsError):
+            nodes[1].update_objects(
+                [(k, "counter_b", "b", ("decrement", (4, 1)))])
+    assert len(mgr.pending) == 3
+    captured = []
+    mgr.request_transfer_many = (
+        lambda dc, entries: captured.append((dc, list(entries))))
+    assert reps[1].bcounter_tick() == 3  # per-ask accounting unchanged
+    # one call, one target DC, all three asks inside
+    assert len(captured) == 1
+    dc, entries = captured[0]
+    assert dc == 0
+    assert sorted(k for k, _b, _n in entries) == ["c1", "c2", "c3"]
+    assert all(b == "b" and n == 4 for _k, b, n in entries)
+    assert mgr.requests_sent_total == 3
+    # same instant: every ask is inside its grace period — no frame
+    assert reps[1].bcounter_tick() == 0
+    assert captured == [(dc, entries)]
+    # after the grace period the batch is re-framed
+    t[0] += 2.0
+    assert reps[1].bcounter_tick() == 3
+    assert len(captured) == 2
+
+
+def test_batched_transfer_grants_end_to_end(dcs):
+    """The ``bcounter_many`` frame round-trips over the real query
+    channel: one request carries three shortfalls, the granter commits
+    three transfers, replication delivers the rights, and the blocked
+    decrements succeed."""
+    hub, nodes, reps = dcs
+    calls = []
+    orig = hub.request
+
+    def counting(target_dc, kind, payload):
+        calls.append(kind)
+        return orig(target_dc, kind, payload)
+
+    hub.request = counting
+    for k in ("c1", "c2", "c3"):
+        nodes[0].update_objects(
+            [(k, "counter_b", "b", ("increment", (10, 0)))])
+    hub.pump()
+    for k in ("c1", "c2", "c3"):
+        with pytest.raises(InsufficientRightsError):
+            nodes[1].update_objects(
+                [(k, "counter_b", "b", ("decrement", (4, 1)))])
+    assert reps[1].bcounter_tick() == 3
+    assert calls == ["bcounter_many"]  # ONE round trip for all three
+    hub.pump()
+    for k in ("c1", "c2", "c3"):
+        nodes[1].update_objects(
+            [(k, "counter_b", "b", ("decrement", (4, 1)))])
+    hub.pump()
+    vc = nodes[1].txm.store.dc_max_vc()
+    for k in ("c1", "c2", "c3"):
+        vals, _ = nodes[0].read_objects([(k, "counter_b", "b")], clock=vc)
+        assert vals[0] == 6
+    assert nodes[1].txm.bcounters.pending == {}

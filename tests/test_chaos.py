@@ -2195,3 +2195,297 @@ def test_storm_soak_many_rounds(cfg):
         assert vals == [total[k] for k in range(6)]
     finally:
         close_mesh(fabrics)
+
+
+# ---------------------------------------------------------------------------
+# scenario 20: the noisy neighbor (ISSUE 19) — tenant `aggro` drives a
+# saturating write storm through its own weighted-fair lane while
+# tenant `vip` keeps reading, under seeded wal-fsync delays and seeded
+# frame drops/delays at the front end.  SIGKILL the serving process
+# mid-storm and respawn it from its WAL.  The isolation contract:
+# vip's read p99 under the storm stays within 3x its SOLO baseline
+# (both phases measured against a warm, fault-seeded server), vip sees
+# ZERO typed refusals (every shed lands on aggro's OWN quota — proven
+# by aggro's typed tenant_busy count), and per-tenant acked writes are
+# all recovered, byte-identical across two independent recoveries.
+# ---------------------------------------------------------------------------
+def test_noisy_neighbor_storm_sigkill_isolation(tmp_path):
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from antidote_tpu.proto.client import (AntidoteClient, RemoteBusy,
+                                           RemoteTenantBusy)
+
+    log_dir = str(tmp_path / "wal")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        ANTIDOTE_FAULT_PLAN=json.dumps({"seed": 20, "rules": [
+            # a stalling volume: the write plane is genuinely slow, so
+            # the aggressor's backlog is real pressure, not a no-op
+            {"site": "wal.fsync", "action": "delay", "p": 0.3,
+             "arg": 0.01},
+            # seeded front-end chop: delayed frames and dropped
+            # connections hit BOTH tenants impartially
+            {"site": "frontend.recv", "action": "delay", "p": 0.05,
+             "arg": 0.008},
+            {"site": "frontend.recv", "action": "drop", "p": 0.01},
+        ]}),
+    )
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "antidote_tpu.console", "serve",
+             "--port", "0", "--shards", "2", "--max-dcs", "2",
+             "--log-dir", log_dir, "--sync-log", "--wal-segments", "3",
+             "--tenant", "aggro:1,max_in_flight=2,max_backlog=4",
+             "--tenant", "vip:4"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+
+    N_AGGRO = 5  # vs max_in_flight=2: the storm MUST trip its quota
+    addr = {}
+    acked = {"aggro": [0] * N_AGGRO, "vip": 0}
+    attempted = {"aggro": [0] * N_AGGRO, "vip": 0}
+    aggro_tenant_busy = [0]
+    vip_typed: list = []   # MUST stay empty: B's contract
+    errs: list = []
+    stop = threading.Event()
+    #: aggressors run only while set — cleared for the solo-baseline
+    #: phase and the kill window
+    storm_on = threading.Event()
+    acct = threading.Lock()
+    lat_solo: list = []
+    lat_storm: list = []
+    #: where the vip reader records latencies right now (None = not
+    #: measuring: warmup, kill window, respawn compile)
+    sink: list = [None]
+
+    def dial():
+        """Redial the CURRENT address until the server answers (rides
+        out both seeded connection drops and the kill window)."""
+        deadline = time.monotonic() + 60.0
+        while not stop.is_set():
+            try:
+                return AntidoteClient(addr["host"], addr["port"])
+            except (ConnectionError, OSError):
+                assert time.monotonic() < deadline, "server never came back"
+                time.sleep(0.05)
+        return None
+
+    def aggressor(i):
+        try:
+            c = dial()
+            while not stop.is_set():
+                if not storm_on.is_set():
+                    time.sleep(0.02)
+                    continue
+                with acct:
+                    attempted["aggro"][i] += 1
+                try:
+                    c.update_objects(
+                        [(f"k{i}", "counter_pn", "aggro/b",
+                          ("increment", 1))])
+                    with acct:
+                        acked["aggro"][i] += 1
+                except RemoteTenantBusy as e:
+                    with acct:
+                        aggro_tenant_busy[0] += 1
+                    assert e.tenant == "aggro"
+                    time.sleep(min(e.retry_after_ms, 100) / 1e3)
+                except RemoteBusy as e:
+                    time.sleep(min(e.retry_after_ms, 100) / 1e3)
+                except (ConnectionError, OSError):
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    c = dial()  # outcome unknown: attempted, not acked
+            if c is not None:
+                c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(f"aggressor{i}: {e!r}")
+
+    def vip_writer():
+        """B's own modest write load — part of B's workload in BOTH
+        phases, so the baseline is B-alone, not reads-alone."""
+        try:
+            c = dial()
+            while not stop.is_set():
+                with acct:
+                    attempted["vip"] += 1
+                try:
+                    c.update_objects(
+                        [("vkey", "counter_pn", "vip/b",
+                          ("increment", 1))])
+                    with acct:
+                        acked["vip"] += 1
+                except (RemoteTenantBusy, RemoteBusy) as e:
+                    vip_typed.append(repr(e))
+                except (ConnectionError, OSError):
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    c = dial()
+                time.sleep(0.03)  # modest, well under vip's share
+            if c is not None:
+                c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(f"vip_writer: {e!r}")
+
+    def vip_reader():
+        try:
+            c = dial()
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    c.read_objects([("vkey", "counter_pn", "vip/b")])
+                    out = sink[0]
+                    if out is not None:
+                        out.append(time.monotonic() - t0)
+                except (RemoteTenantBusy, RemoteBusy) as e:
+                    vip_typed.append(repr(e))
+                except (ConnectionError, OSError):
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    c = dial()
+            if c is not None:
+                c.close()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(f"vip_reader: {e!r}")
+
+    def p99(lats):
+        xs = sorted(lats)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+    def wait_for(cond, why, budget=90.0):
+        deadline = time.monotonic() + budget
+        while not cond():
+            assert time.monotonic() < deadline, why()
+            assert not errs, errs
+            time.sleep(0.05)
+
+    proc = spawn()
+    threads = []
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        assert set(info.get("tenants", ())) >= {"aggro", "vip"}
+        addr.update(host=info["host"], port=info["port"])
+        c = dial()
+        c.update_objects([("vkey", "counter_pn", "vip/b",
+                           ("increment", 1))])
+        c.close()
+        threads = [threading.Thread(target=aggressor, args=(i,))
+                   for i in range(N_AGGRO)]
+        threads += [threading.Thread(target=vip_writer),
+                    threading.Thread(target=vip_reader)]
+        for t in threads:
+            t.start()
+        # -- phase 0: warmup burst.  The first merged commit batches
+        # compile their XLA kernels (each width once per process);
+        # neither measured phase may bill that one-time cost
+        storm_on.set()
+        wait_for(lambda: sum(acked["aggro"]) >= 30 and acked["vip"] >= 2,
+                 lambda: f"warmup stalled: {acked}")
+        # -- phase 1: SOLO baseline — B alone on the warm server,
+        # same fault plan
+        storm_on.clear()
+        time.sleep(0.5)  # drain the aggressors' in-flight tail
+        sink[0] = lat_solo
+        wait_for(lambda: len(lat_solo) >= 250,
+                 lambda: f"solo baseline stalled: {len(lat_solo)}")
+        sink[0] = None
+        # -- phase 2: the storm — 8 aggressor writers vs vip's lane
+        base = sum(acked["aggro"])
+        storm_on.set()
+        sink[0] = lat_storm
+        wait_for(lambda: (sum(acked["aggro"]) >= base + 40
+                          and aggro_tenant_busy[0] >= 1
+                          and len(lat_storm) >= 250),
+                 lambda: (f"storm never saturated: "
+                          f"aggro={sum(acked['aggro'])} "
+                          f"tenant_busy={aggro_tenant_busy[0]} "
+                          f"vip_reads={len(lat_storm)}"))
+        # -- phase 3: SIGKILL mid-storm, respawn from the WAL, keep the
+        # storm running.  Latency recording pauses for the kill window
+        # and the reborn process's one-time compile (restart warmup any
+        # single-tenant deployment pays identically), then resumes
+        sink[0] = None
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc = spawn()
+        info = json.loads(proc.stdout.readline())
+        assert info["ready"] is True
+        addr.update(host=info["host"], port=info["port"])
+        a0 = sum(acked["aggro"])
+        wait_for(lambda: sum(acked["aggro"]) >= a0 + 10,
+                 lambda: (f"storm never resumed post-kill: "
+                          f"{sum(acked['aggro'])} (was {a0})"))
+        reads0 = len(lat_storm)
+        sink[0] = lat_storm
+        wait_for(lambda: (sum(acked["aggro"]) >= a0 + 30
+                          and len(lat_storm) >= reads0 + 100),
+                 lambda: (f"post-kill storm stalled: "
+                          f"aggro={sum(acked['aggro'])} "
+                          f"vip_reads={len(lat_storm)}"))
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        threads = []
+        assert not errs, errs
+        # -- the isolation guarantee -----------------------------------
+        # vip's p99 under the storm within 3x its solo baseline, with a
+        # 14 ms noise floor: the XLA CPU backend runs device work
+        # serially, so a read gather that arrives while ANY commit
+        # group occupies the device waits out that computation —
+        # a ~10-30 ms floor on a shared 2-core box that exists even
+        # with a single tenant committing its own writes.  A genuine
+        # lane leak parks reads behind the aggressor's *backlog*
+        # (100 ms+ at these queue depths); the 3x-over-floor bound
+        # separates the two cleanly.
+        solo, storm = p99(lat_solo), p99(lat_storm)
+        assert storm <= 3.0 * max(solo, 0.014), (
+            f"noisy neighbor leaked: solo p99={solo * 1e3:.2f}ms "
+            f"storm p99={storm * 1e3:.2f}ms")
+        # B saw ZERO typed refusals — every shed landed on A's quota
+        assert vip_typed == [], vip_typed
+        assert aggro_tenant_busy[0] >= 1  # the storm really saturated
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # -- per-tenant durability: acked ⊆ recovered, double recovery
+    # byte-identical (the kill must not have eaten either tenant's acks)
+    rcfg = AntidoteConfig(n_shards=2, max_dcs=2, wal_segments=3)
+    objs = ([(f"k{i}", "counter_pn", "aggro/b") for i in range(N_AGGRO)]
+            + [("vkey", "counter_pn", "vip/b")])
+    recovered = []
+    for _ in range(2):
+        node = AntidoteNode(rcfg, log_dir=log_dir, recover=True)
+        vals, _ = node.read_objects(objs)
+        recovered.append({
+            "vals": vals,
+            "op_ids": node.store.log.op_ids.tolist(),
+            "seqs": node.store.log.seqs.tolist(),
+        })
+        node.store.log.close()
+    assert recovered[0] == recovered[1], "recoveries diverged"
+    vals = recovered[0]["vals"]
+    for i in range(N_AGGRO):
+        assert acked["aggro"][i] <= vals[i] <= attempted["aggro"][i], (
+            f"aggro k{i}: acked={acked['aggro'][i]} recovered={vals[i]} "
+            f"attempted={attempted['aggro'][i]}")
+    # vip's seed write rides the same key: +1 on both bounds
+    assert acked["vip"] + 1 <= vals[-1] <= attempted["vip"] + 1, (
+        f"vip: acked={acked['vip']} recovered={vals[-1]} "
+        f"attempted={attempted['vip']}")

@@ -10,6 +10,7 @@ from antidote_tpu.cluster import (ClusterMember, ClusterNode, attach_interdc,
                                   cluster_query_router)
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.meta import MetaDataStore
+from antidote_tpu.overload import InsufficientRightsError
 from antidote_tpu.txn.manager import AbortError
 
 
@@ -177,7 +178,7 @@ def test_cluster_bcounter_transfer_from_clustered_dc():
     # DC1 observes the counter but holds no rights
     vals, _ = node1.read_objects([(k, "counter_b", "b")], clock=vc)
     assert vals == [10]
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         node1.update_objects([(k, "counter_b", "b", ("decrement", (3, 1)))])
     # the failed decrement queued a transfer request; run the loop
     moved = r1.bcounter_tick()
@@ -190,7 +191,7 @@ def test_cluster_bcounter_transfer_from_clustered_dc():
             node1.update_objects([(k, "counter_b", "b",
                                    ("decrement", (3, 1)))])
             break
-        except AbortError:
+        except InsufficientRightsError:
             continue
     else:
         raise AssertionError("transferred rights never became spendable")

@@ -10,7 +10,7 @@ from antidote_tpu.api import AntidoteNode
 from antidote_tpu.config import AntidoteConfig
 from antidote_tpu.interdc import DCReplica
 from antidote_tpu.interdc.tcp import TcpFabric
-from antidote_tpu.txn.manager import AbortError
+from antidote_tpu.overload import InsufficientRightsError
 
 
 @pytest.fixture
@@ -97,7 +97,7 @@ def test_bcounter_transfer_over_socket_query_channel(dcs):
     fabrics, nodes, reps = dcs
     nodes[0].update_objects([("c", "counter_b", "b", ("increment", (10, 0)))])
     pump_all(fabrics)
-    with pytest.raises(AbortError):
+    with pytest.raises(InsufficientRightsError):
         nodes[1].update_objects([("c", "counter_b", "b", ("decrement", (4, 1)))])
     assert reps[1].bcounter_tick() == 1   # RPC to DC0 over the socket
     pump_all(fabrics)

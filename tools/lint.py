@@ -126,6 +126,7 @@ def check_file(path: str):
     _check_epoch_stamp(path, lines, problems)
     _check_evict_policy(path, lines, problems)
     _check_py_socket(path, lines, problems)
+    _check_tenant_labels(tree, path, lines, problems)
     return problems
 
 
@@ -432,6 +433,49 @@ def _check_py_socket(path, lines, problems) -> None:
                 "— the native plane (proto/cpp/frontend.cc) owns "
                 "accept/framing/replies; a Python-plane site must "
                 "justify with '# py-socket-ok: <reason>'"
+            )
+
+
+#: tenant-labeled metrics (ISSUE 19): a ``tenant=`` label fed from the
+#: wire (a client-chosen string) is an unbounded-cardinality leak — one
+#: hostile client mints one Prometheus series per request.  Every
+#: tenant-labeled ``.inc(``/``.set(``/``.observe(`` in the package must
+#: clamp its value through the bounded TenantRegistry label set
+#: (``registry.label(...)`` / a ``TenantLanes`` lane name) and say so
+#: with a ``# tenant-label-ok: <where the value was clamped>`` note on
+#: the line or within the three preceding lines.
+_TENANT_LABEL_PLANE = "antidote_tpu" + os.sep
+_TENANT_METRIC_METHODS = ("inc", "set", "observe")
+
+
+def _check_tenant_labels(tree, path, lines, problems) -> None:
+    """Reject metric calls carrying a ``tenant=`` label unless annotated
+    ``# tenant-label-ok:`` — the label value must come from the bounded
+    TenantRegistry set, never straight from the wire."""
+    norm = os.path.normpath(path)
+    if not (norm.startswith(_TENANT_LABEL_PLANE)
+            or os.sep + _TENANT_LABEL_PLANE in norm) \
+            or os.path.basename(norm) == "tenancy.py":  # defines the clamp
+        return
+
+    def annotated(lineno: int) -> bool:
+        lo = max(0, lineno - 4)
+        return any("tenant-label-ok:" in ln for ln in lines[lo:lineno])
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TENANT_METRIC_METHODS):
+            continue
+        if not any(k.arg == "tenant" for k in node.keywords):
+            continue
+        if not annotated(node.lineno):
+            problems.append(
+                f"{path}:{node.lineno}: tenant-labeled metric without a "
+                "'# tenant-label-ok:' note — clamp the value through "
+                "the bounded TenantRegistry label set "
+                "(registry.label(...)) and annotate where it was clamped"
             )
 
 
